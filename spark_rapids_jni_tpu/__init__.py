@@ -1,0 +1,36 @@
+"""spark_rapids_jni_tpu — a TPU-native Spark acceleration layer.
+
+A from-scratch JAX/XLA/Pallas/PJRT framework with the capabilities of
+NVIDIA's spark-rapids-jni (CUDA/libcudf) reference: device columnar tables,
+JCUDF row↔column transcode, Parquet footer parse/prune/serialize, a columnar
+op library, ICI shuffle, and fault-injection tooling.  See SURVEY.md for the
+reference structural analysis this build follows.
+"""
+
+import os as _os
+
+import jax as _jax
+
+# The JCUDF type surface includes int64/float64/decimal64 columns
+# (tests/row_conversion.cpp:546-707 in the reference); JAX needs x64 enabled
+# for those payloads.  NOTE: this is process-global JAX config — embedding
+# applications that must keep 32-bit JAX defaults can opt out with
+# SPARK_RAPIDS_TPU_NO_X64=1 (64-bit column types then raise at use).
+if _os.environ.get("SPARK_RAPIDS_TPU_NO_X64", "0") != "1":
+    _jax.config.update("jax_enable_x64", True)
+
+from . import types  # noqa: E402
+from .types import (  # noqa: E402,F401
+    DType, TypeId,
+    int8, int16, int32, int64, uint8, uint16, uint32, uint64,
+    float32, float64, bool8, string,
+    timestamp_days, timestamp_seconds, timestamp_ms, timestamp_us, timestamp_ns,
+    decimal32, decimal64,
+)
+from .column import Column, Table  # noqa: E402,F401
+from .rowconv import (  # noqa: E402,F401
+    RowLayout, compute_row_layout, build_batches,
+    convert_to_rows, convert_from_rows,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,191 @@
+"""Device columnar table core.
+
+This is the TPU-native replacement for the libcudf column/table ownership model
+the reference leans on (SURVEY §2.9: ``make_fixed_width_column`` /
+``make_strings_column`` / ``make_lists_column``, ``row_conversion.cu:1264,
+2094, 2240``).  Design choices, TPU-first:
+
+* A ``Column`` is a pytree of flat JAX arrays living in HBM — data, optional
+  string offsets (Arrow layout: int32 [n+1] offsets + uint8 chars), optional
+  validity.  Tables flow through ``jax.jit`` directly; XLA owns placement and
+  fusion, so there is no RMM-style manual pool (PJRT's BFC arena is the
+  allocator).
+* Validity is carried as a *boolean vector* (one lane per row) rather than a
+  packed bitmask: on the VPU a bool lane fuses into every elementwise op for
+  free, while packed words would need unpack/repack around each op.  Arrow/
+  cudf-style little-endian bitmasks are produced on demand via
+  ``utils.bitmask`` for interchange (and for the JCUDF validity bytes).
+* BOOL8 columns store uint8 0/1 payloads (JCUDF stores bools as one byte,
+  ``RowConversion.java:60-67``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import types as T
+from .utils import bitmask
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Column:
+    """A single device column.
+
+    Fixed-width: ``data`` is [n] of ``dtype.storage``; ``offsets`` is None.
+    STRING: ``data`` is the uint8 chars buffer [total_bytes]; ``offsets`` is
+    int32 [n+1] (Arrow layout, same as cudf's offsets+chars children —
+    SURVEY §2.9).
+    ``validity``: bool [n], True = valid; None = all rows valid.
+    """
+
+    dtype: T.DType
+    data: jnp.ndarray
+    offsets: Optional[jnp.ndarray] = None
+    validity: Optional[jnp.ndarray] = None
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.offsets, self.validity), self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, dtype, children):
+        data, offsets, validity = children
+        return cls(dtype, data, offsets, validity)
+
+    # -- basics -------------------------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        if self.dtype.is_variable_width:
+            return self.offsets.shape[0] - 1
+        return self.data.shape[0]
+
+    def __len__(self) -> int:
+        return self.num_rows
+
+    @property
+    def null_count(self) -> int:
+        if self.validity is None:
+            return 0
+        return int(jnp.sum(~self.validity))
+
+    def validity_or_true(self) -> jnp.ndarray:
+        if self.validity is None:
+            return jnp.ones((self.num_rows,), dtype=jnp.bool_)
+        return self.validity
+
+    def validity_bitmask(self) -> jnp.ndarray:
+        """Arrow/cudf little-endian packed validity bitmask (uint8)."""
+        return bitmask.pack_bits(self.validity_or_true())
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_numpy(arr: np.ndarray, dtype: T.DType | None = None,
+                   validity: np.ndarray | None = None) -> "Column":
+        arr = np.asarray(arr)
+        if dtype is None:
+            dtype = T.from_numpy(arr.dtype)
+        storage = np.ascontiguousarray(arr, dtype=dtype.storage)
+        v = None if validity is None else jnp.asarray(np.asarray(validity, dtype=bool))
+        return Column(dtype, jnp.asarray(storage), validity=v)
+
+    @staticmethod
+    def strings_from_list(strings: Sequence[Optional[str]]) -> "Column":
+        """Build a STRING column from host strings (None ⇒ null row)."""
+        valid = np.asarray([s is not None for s in strings], dtype=bool)
+        payloads = [s.encode("utf-8") if s is not None else b"" for s in strings]
+        lengths = np.asarray([len(p) for p in payloads], dtype=np.int32)
+        offsets = np.zeros(len(strings) + 1, dtype=np.int32)
+        np.cumsum(lengths, out=offsets[1:])
+        chars = np.frombuffer(b"".join(payloads), dtype=np.uint8).copy()
+        v = None if valid.all() else jnp.asarray(valid)
+        return Column(T.string, jnp.asarray(chars), jnp.asarray(offsets), v)
+
+    # -- host round-trip (tests / interchange) ------------------------------
+    def to_numpy(self) -> np.ndarray:
+        """Host copy of the payload (fixed-width columns only)."""
+        return np.asarray(self.data)
+
+    def to_pylist(self):
+        """Host list with ``None`` for nulls — test/debug convenience."""
+        valid = np.asarray(self.validity_or_true())
+        if self.dtype.id == T.TypeId.STRING:
+            offsets = np.asarray(self.offsets)
+            chars = np.asarray(self.data).tobytes()
+            out = []
+            for i in range(self.num_rows):
+                if not valid[i]:
+                    out.append(None)
+                else:
+                    out.append(chars[offsets[i]:offsets[i + 1]].decode("utf-8"))
+            return out
+        vals = np.asarray(self.data)
+        if self.dtype.id == T.TypeId.BOOL8:
+            vals = vals.astype(bool)
+        return [vals[i].item() if valid[i] else None for i in range(self.num_rows)]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Table:
+    """An ordered collection of equal-length columns (cudf::table_view analog)."""
+
+    columns: list[Column]
+
+    def __post_init__(self):
+        if self.columns:
+            n = self.columns[0].num_rows
+            for i, c in enumerate(self.columns):
+                if c.num_rows != n:
+                    raise ValueError(
+                        f"column {i} has {c.num_rows} rows, expected {n}")
+
+    def tree_flatten(self):
+        return (self.columns,), None
+
+    @classmethod
+    def tree_unflatten(cls, _, children):
+        obj = cls.__new__(cls)
+        obj.columns = children[0]
+        return obj
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    @property
+    def num_rows(self) -> int:
+        return self.columns[0].num_rows if self.columns else 0
+
+    @property
+    def schema(self) -> list[T.DType]:
+        return [c.dtype for c in self.columns]
+
+    def __getitem__(self, i: int) -> Column:
+        return self.columns[i]
+
+    def __iter__(self):
+        return iter(self.columns)
+
+    @staticmethod
+    def from_pydict(data: dict, dtypes: dict | None = None) -> "Table":
+        cols = []
+        for name, values in data.items():
+            dt = (dtypes or {}).get(name)
+            if (dt is not None and dt.id == T.TypeId.STRING) or (
+                    dt is None and values and isinstance(
+                        next((v for v in values if v is not None), None), str)):
+                cols.append(Column.strings_from_list(values))
+            else:
+                arr = np.asarray([0 if v is None else v for v in values])
+                validity = (np.asarray([v is not None for v in values])
+                            if any(v is None for v in values) else None)
+                if dt is not None:
+                    arr = arr.astype(dt.storage)
+                cols.append(Column.from_numpy(arr, dt, validity))
+        return Table(cols)

@@ -1,0 +1,402 @@
+"""Device row↔column transcode (JCUDF) — the XLA path.
+
+TPU-native reimplementation of the reference's CUDA engine
+(``row_conversion.cu``; public surface ``row_conversion.hpp:27-49``).  Design
+translation (see SURVEY §7):
+
+* The reference hand-tiles shared memory and double-buffers
+  ``cuda::memcpy_async`` (``row_conversion.cu:575-693,892-993``).  On TPU the
+  transpose is expressed as pure array ops — per-column byte views
+  (``lax.bitcast_convert_type``) written into a [rows, row_size] byte matrix —
+  and XLA fuses the whole thing into a handful of vectorized HBM passes; a
+  Pallas kernel (``pallas_kernels.py``) covers the cases XLA schedules poorly.
+* The warp-ballot validity transpose (``row_conversion.cu:710-810``)
+  becomes a weighted-sum bit pack (``utils.bitmask.pack_bool_matrix``).
+* Variable-width (string) handling follows the reference's two-phase shape
+  discipline (size pass → alloc → copy pass; the reference syncs on the total
+  at ``row_conversion.cu:2215``): row sizes and char totals are resolved on
+  host, then a statically-shaped jitted scatter/gather does the copies.
+* Output is split into ≤2GB batches exactly like ``build_batches``
+  (``row_conversion.cu:1460-1539``); ``convert_from_rows`` accepts exactly one
+  batch (``row_conversion.cu:2124-2139``).
+
+Dynamic-shape note: everything under ``jit`` here is static-shaped; the only
+host syncs are the same ones the reference performs (string totals).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..column import Column, Table
+from ..utils import bitmask
+from ..utils.tracing import traced
+from .layout import (RowLayout, compute_row_layout, build_batches,
+                     row_sizes_with_strings, MAX_ROW_SIZE, MAX_BATCH_BYTES)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class RowBatch:
+    """One ≤2GB batch of JCUDF rows: the LIST<INT8> column analog
+    (``row_conversion.cu:1869-1889``)."""
+
+    data: jnp.ndarray      # uint8 [total_bytes]
+    offsets: jnp.ndarray   # int32 [num_rows + 1]
+
+    def tree_flatten(self):
+        return (self.data, self.offsets), None
+
+    @classmethod
+    def tree_unflatten(cls, _, children):
+        return cls(*children)
+
+    @property
+    def num_rows(self) -> int:
+        return self.offsets.shape[0] - 1
+
+    @property
+    def num_bytes(self) -> int:
+        return self.data.shape[0]
+
+
+def _is_f64(storage: np.dtype) -> bool:
+    return storage.kind == "f" and storage.itemsize == 8
+
+
+def _byte_view(data: jnp.ndarray, storage: np.dtype) -> jnp.ndarray:
+    """[n] fixed-width values → uint8 [n, itemsize] (little-endian).
+
+    FLOAT64 payloads arrive *staged* as uint32 [n, 2] (see ``_stage``):
+    XLA:TPU emulates f64 and exposes no bit-level access to it
+    (``bitcast_convert_type`` on f64 fails in the x64-rewrite pass), so the
+    transcode — which only moves bytes, never does arithmetic — works on the
+    u32 halves instead.
+    """
+    if _is_f64(storage):
+        return jax.lax.bitcast_convert_type(data, jnp.uint8).reshape(
+            data.shape[0], 8)
+    data = data.astype(storage)
+    if storage.itemsize == 1:
+        return data.view(jnp.uint8).reshape(-1, 1)
+    return jax.lax.bitcast_convert_type(data, jnp.uint8)
+
+
+def _from_bytes(b: jnp.ndarray, storage: np.dtype) -> jnp.ndarray:
+    """uint8 [n, itemsize] → [n] of storage dtype (f64: staged uint32 [n,2])."""
+    if _is_f64(storage):
+        return jax.lax.bitcast_convert_type(b.reshape(-1, 2, 4), jnp.uint32)
+    if storage.itemsize == 1:
+        return b.reshape(-1).view(jnp.dtype(storage))
+    return jax.lax.bitcast_convert_type(b, jnp.dtype(storage))
+
+
+def _stage(col: Column) -> jnp.ndarray:
+    """Payload handed to the jit cores; f64 becomes uint32 [n, 2] halves."""
+    if col.dtype.is_fixed_width and _is_f64(col.dtype.storage):
+        return jnp.asarray(
+            np.ascontiguousarray(np.asarray(col.data)).view(np.uint32).reshape(-1, 2))
+    return col.data
+
+
+def _unstage(data: jnp.ndarray, storage: np.dtype) -> jnp.ndarray:
+    if _is_f64(storage):
+        return jnp.asarray(
+            np.ascontiguousarray(np.asarray(data)).view(np.float64).reshape(-1))
+    return data
+
+
+# ---------------------------------------------------------------------------
+# fixed-width core: [cols…] → uint8 [n, fixed_row_size]
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=0)
+def _to_rows_fixed(layout: RowLayout, datas: tuple[jnp.ndarray, ...],
+                   valid: jnp.ndarray) -> jnp.ndarray:
+    n = valid.shape[0]
+    out = jnp.zeros((n, layout.fixed_row_size), dtype=jnp.uint8)
+    for ci, dt in enumerate(layout.schema):
+        start = layout.column_starts[ci]
+        b = _byte_view(datas[ci], dt.storage)
+        out = out.at[:, start:start + layout.column_sizes[ci]].set(b)
+    vbytes = bitmask.pack_bool_matrix(valid)
+    out = out.at[:, layout.validity_offset:
+                 layout.validity_offset + layout.validity_bytes].set(vbytes)
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _from_rows_fixed(layout: RowLayout, rows: jnp.ndarray):
+    """uint8 [n, fixed_row_size] → (datas tuple, valid bool [n, ncols])."""
+    datas = []
+    for ci, dt in enumerate(layout.schema):
+        start = layout.column_starts[ci]
+        b = rows[:, start:start + layout.column_sizes[ci]]
+        datas.append(_from_bytes(b, dt.storage))
+    vbytes = rows[:, layout.validity_offset:
+                  layout.validity_offset + layout.validity_bytes]
+    valid = bitmask.unpack_bool_matrix(vbytes, layout.num_columns)
+    return tuple(datas), valid
+
+
+# ---------------------------------------------------------------------------
+# variable-width core (strings): statically-shaped scatter/gather
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _to_rows_var(layout: RowLayout, total_bytes: int,
+                 datas: tuple[jnp.ndarray, ...],
+                 str_offsets: tuple[jnp.ndarray, ...],
+                 valid: jnp.ndarray,
+                 row_offsets: jnp.ndarray) -> jnp.ndarray:
+    """Strings path: scatter fixed slots, (offset,len) pairs, validity and
+    chars into one flat byte buffer (``copy_strings_to_rows`` semantics,
+    row_conversion.cu:852-874)."""
+    n = valid.shape[0]
+    row_base = row_offsets[:-1].astype(jnp.int64)          # [n]
+    out = jnp.zeros((total_bytes,), dtype=jnp.uint8)
+
+    # per-row, per-variable-column char lengths and exclusive prefix
+    var_idx = layout.variable_column_indices
+    lens = jnp.stack(
+        [str_offsets[vi][1:] - str_offsets[vi][:-1] for vi in range(len(var_idx))],
+        axis=1).astype(jnp.int64)                           # [n, nvar]
+    prefix = jnp.cumsum(lens, axis=1) - lens                # exclusive, [n, nvar]
+
+    vi_of_ci = {ci: vi for vi, ci in enumerate(var_idx)}
+    for ci, dt in enumerate(layout.schema):
+        start = layout.column_starts[ci]
+        if dt.is_variable_width:
+            vi = vi_of_ci[ci]
+            slot_off = (layout.fixed_plus_validity + prefix[:, vi]).astype(jnp.uint32)
+            slot = jnp.stack([slot_off, lens[:, vi].astype(jnp.uint32)], axis=1)
+            b = jax.lax.bitcast_convert_type(slot, jnp.uint8).reshape(n, 8)
+        else:
+            b = _byte_view(datas[ci], dt.storage)
+        pos = row_base[:, None] + start + jnp.arange(b.shape[1])[None, :]
+        out = out.at[pos.reshape(-1)].set(b.reshape(-1))
+
+    # validity bytes
+    vbytes = bitmask.pack_bool_matrix(valid)
+    pos = (row_base[:, None] + layout.validity_offset
+           + jnp.arange(layout.validity_bytes)[None, :])
+    out = out.at[pos.reshape(-1)].set(vbytes.reshape(-1))
+
+    # chars: for each variable column, scatter its flat chars buffer
+    for vi, ci in enumerate(var_idx):
+        chars = datas[ci]
+        total_chars = chars.shape[0]
+        if total_chars == 0:
+            continue
+        offs = str_offsets[vi].astype(jnp.int64)
+        char_ids = jnp.arange(total_chars, dtype=jnp.int64)
+        row_of = jnp.searchsorted(offs, char_ids, side="right") - 1
+        dest = (row_base[row_of] + layout.fixed_plus_validity
+                + prefix[row_of, vi] + (char_ids - offs[row_of]))
+        out = out.at[dest].set(chars)
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _gather_var_slots(layout: RowLayout, data: jnp.ndarray,
+                      row_offsets: jnp.ndarray):
+    """Phase 1 of from_rows with strings: pull each row's (offset,len) slots."""
+    row_base = row_offsets[:-1].astype(jnp.int64)
+    slots = []
+    for ci in layout.variable_column_indices:
+        start = layout.column_starts[ci]
+        pos = row_base[:, None] + start + jnp.arange(8)[None, :]
+        b = data[pos.reshape(-1)].reshape(-1, 2, 4)
+        slots.append(jax.lax.bitcast_convert_type(b, jnp.uint32))  # [n, 2]
+    return tuple(slots)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _from_rows_var(layout: RowLayout, char_totals: tuple[int, ...],
+                   data: jnp.ndarray, row_offsets: jnp.ndarray,
+                   out_offsets: tuple[jnp.ndarray, ...]):
+    """Phase 2: gather fixed slots, validity, and chars buffers."""
+    row_base = row_offsets[:-1].astype(jnp.int64)
+    n = row_base.shape[0]
+
+    datas = []
+    for ci, dt in enumerate(layout.schema):
+        if dt.is_variable_width:
+            datas.append(None)
+            continue
+        start = layout.column_starts[ci]
+        sz = layout.column_sizes[ci]
+        pos = row_base[:, None] + start + jnp.arange(sz)[None, :]
+        b = data[pos.reshape(-1)].reshape(n, sz)
+        datas.append(_from_bytes(b, dt.storage))
+
+    pos = (row_base[:, None] + layout.validity_offset
+           + jnp.arange(layout.validity_bytes)[None, :])
+    vbytes = data[pos.reshape(-1)].reshape(n, layout.validity_bytes)
+    valid = bitmask.unpack_bool_matrix(vbytes, layout.num_columns)
+
+    chars_out = []
+    for vi, ci in enumerate(layout.variable_column_indices):
+        total = char_totals[vi]
+        offs = out_offsets[vi].astype(jnp.int64)            # [n+1]
+        start = layout.column_starts[ci]
+        pos = row_base[:, None] + start + jnp.arange(8)[None, :]
+        slot = jax.lax.bitcast_convert_type(
+            data[pos.reshape(-1)].reshape(n, 2, 4), jnp.uint32)
+        src_base = row_base + slot[:, 0].astype(jnp.int64)  # chars start per row
+        if total == 0:
+            chars_out.append(jnp.zeros((0,), dtype=jnp.uint8))
+            continue
+        char_ids = jnp.arange(total, dtype=jnp.int64)
+        row_of = jnp.searchsorted(offs, char_ids, side="right") - 1
+        src = src_base[row_of] + (char_ids - offs[row_of])
+        chars_out.append(data[src])
+    return tuple(datas), valid, tuple(chars_out)
+
+
+# ---------------------------------------------------------------------------
+# public API (row_conversion.hpp:27-49 surface)
+# ---------------------------------------------------------------------------
+
+def _table_valid_matrix(table: Table) -> jnp.ndarray:
+    return jnp.stack([c.validity_or_true() for c in table.columns], axis=1)
+
+
+def _check_row_size(layout: RowLayout, row_sizes: np.ndarray | None = None):
+    worst = (layout.fixed_row_size if row_sizes is None
+             else int(row_sizes.max(initial=0)))
+    if worst > MAX_ROW_SIZE:
+        raise ValueError(
+            f"row size {worst} exceeds JCUDF limit {MAX_ROW_SIZE} "
+            "(RowConversion.java:98-99)")
+
+
+@traced("convert_to_rows")
+def convert_to_rows(table: Table,
+                    max_batch_bytes: Optional[int] = None) -> list[RowBatch]:
+    """Table → JCUDF row batches (``convert_to_rows``, row_conversion.cu:1902-1960).
+
+    Returns one or more :class:`RowBatch` (LIST<INT8> analog), each ≤2GB.
+    """
+    max_batch_bytes = max_batch_bytes or MAX_BATCH_BYTES
+    layout = compute_row_layout(table.schema)
+    n = table.num_rows
+
+    if layout.fixed_width_only:
+        _check_row_size(layout)
+        row_sizes = np.full(n, layout.fixed_row_size, dtype=np.int64)
+    else:
+        total_lens = np.zeros(n, dtype=np.int64)
+        for ci in layout.variable_column_indices:
+            offs = np.asarray(table[ci].offsets, dtype=np.int64)
+            total_lens += offs[1:] - offs[:-1]
+        row_sizes = row_sizes_with_strings(layout, total_lens)
+        _check_row_size(layout, row_sizes)
+
+    batches = build_batches(row_sizes, max_batch_bytes)
+    out: list[RowBatch] = []
+    for bi, (lo, hi) in enumerate(zip(batches.row_boundaries[:-1],
+                                      batches.row_boundaries[1:])):
+        sub = Table([_slice_column(c, lo, hi) for c in table.columns])
+        valid = _table_valid_matrix(sub)
+        if layout.fixed_width_only:
+            rows2d = _to_rows_fixed(layout, tuple(_stage(c) for c in sub.columns),
+                                    valid)
+            data = rows2d.reshape(-1)
+        else:
+            row_offs = jnp.asarray(
+                batches.row_offsets_within_batch[bi].astype(np.int64))
+            data = _to_rows_var(
+                layout, batches.batch_bytes[bi],
+                tuple(_stage(c) for c in sub.columns),
+                # _slice_column already rebases string offsets to zero
+                tuple(sub[ci].offsets
+                      for ci in layout.variable_column_indices),
+                valid, row_offs)
+        out.append(RowBatch(
+            data, jnp.asarray(batches.row_offsets_within_batch[bi])))
+    return out
+
+
+def _slice_column(col: Column, lo: int, hi: int) -> Column:
+    v = None if col.validity is None else col.validity[lo:hi]
+    if col.dtype.is_variable_width:
+        offs = col.offsets[lo:hi + 1]
+        clo = int(col.offsets[lo])
+        chi = int(col.offsets[hi])
+        return Column(col.dtype, col.data[clo:chi], offs - clo, v)
+    return Column(col.dtype, col.data[lo:hi], validity=v)
+
+
+@traced("convert_from_rows")
+def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
+    """JCUDF rows → Table (``convert_from_rows``, row_conversion.cu:2032-2250).
+
+    Like the reference, accepts exactly one batch (row_conversion.cu:2124-2139).
+    """
+    schema = list(schema)
+    layout = compute_row_layout(schema)
+    n = batch.num_rows
+    row_offsets = batch.offsets.astype(jnp.int64)
+
+    if layout.fixed_width_only:
+        rows2d = batch.data.reshape(n, layout.fixed_row_size)
+        datas, valid = _from_rows_fixed(layout, rows2d)
+        return _assemble(schema, datas, valid, None, None)
+
+    # strings: phase 1 — lengths; host sync for char totals (reference syncs
+    # identically at row_conversion.cu:2215)
+    slots = _gather_var_slots(layout, batch.data, row_offsets)
+    out_offsets = []
+    char_totals = []
+    for s in slots:
+        lens = np.asarray(s[:, 1], dtype=np.int64)
+        offs = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(lens, out=offs[1:])
+        out_offsets.append(jnp.asarray(offs))
+        char_totals.append(int(offs[-1]))
+    datas, valid, chars = _from_rows_var(
+        layout, tuple(char_totals), batch.data, row_offsets,
+        tuple(out_offsets))
+    return _assemble(schema, datas, valid, chars,
+                     [o.astype(jnp.int32) for o in out_offsets])
+
+
+def _assemble(schema, datas, valid, chars, out_offsets) -> Table:
+    valid_np = np.asarray(valid)
+    cols = []
+    vi = 0
+    for ci, dt in enumerate(schema):
+        v = None if valid_np[:, ci].all() else jnp.asarray(valid_np[:, ci])
+        if dt.is_variable_width:
+            cols.append(Column(dt, chars[vi], out_offsets[vi], v))
+            vi += 1
+        else:
+            cols.append(Column(dt, _unstage(datas[ci], dt.storage), validity=v))
+    return Table(cols)
+
+
+# Legacy-path parity aliases.  The reference keeps a second, simpler CUDA
+# implementation for narrow fixed-width tables (row_conversion.cu:425-551,
+# 1962-2030) and uses it as a differential oracle; on TPU there is one XLA
+# path (the tiling split is a CUDA shared-memory artifact) and the NumPy
+# oracle (reference.py) plays the differential role.
+
+def convert_to_rows_fixed_width_optimized(table: Table) -> list[RowBatch]:
+    if not all(c.dtype.is_fixed_width for c in table.columns):
+        raise ValueError("fixed-width-optimized path requires fixed-width schema")
+    return convert_to_rows(table)
+
+
+def convert_from_rows_fixed_width_optimized(batch: RowBatch,
+                                            schema: Sequence[T.DType]) -> Table:
+    if not all(dt.is_fixed_width for dt in schema):
+        raise ValueError("fixed-width-optimized path requires fixed-width schema")
+    return convert_from_rows(batch, schema)

@@ -1,0 +1,1 @@
+from . import bitmask, tracing  # noqa: F401

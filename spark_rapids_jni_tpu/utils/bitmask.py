@@ -1,0 +1,75 @@
+"""Validity bitmask utilities.
+
+The reference stores validity as an Arrow-style little-endian bitmask and
+transposes it between column bitmasks and per-row validity bytes with warp
+ballot tricks (``row_conversion.cu:710-810`` col→row, ``:1010-1116`` row→col;
+bit utilities ``word_index``/``bit_is_set`` come from libcudf,
+``row_conversion.cu:416,512``).
+
+On TPU there are no warps or ballots; the idiomatic equivalent keeps validity
+as a boolean vector on-device (one lane per row — VPU-friendly, fuses into any
+elementwise op) and packs/unpacks to the little-endian bitmask with a reshape +
+weighted-sum, which XLA lowers to a handful of vector ops.  The
+``__ballot_sync`` bit-transpose trick (``row_conversion.cu:765-776``) becomes
+``pack_bool_matrix``: an (8,)-weighted reduction along the column axis.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_BIT_WEIGHTS = np.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=np.uint8)
+
+
+def pack_bits(valid: jnp.ndarray) -> jnp.ndarray:
+    """Pack a boolean vector [n] into a little-endian bitmask of uint8 [⌈n/8⌉].
+
+    Bit ``i`` of byte ``j`` is element ``j*8 + i`` (Arrow/cudf bit order).
+    """
+    n = valid.shape[0]
+    nbytes = -(-n // 8)
+    padded = jnp.zeros((nbytes * 8,), dtype=jnp.uint8).at[:n].set(
+        valid.astype(jnp.uint8))
+    return (padded.reshape(nbytes, 8) * jnp.asarray(_BIT_WEIGHTS)).sum(
+        axis=1, dtype=jnp.uint8)
+
+
+def unpack_bits(mask: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Unpack a little-endian uint8 bitmask into a boolean vector [n]."""
+    bits = (mask[:, None] >> jnp.arange(8, dtype=jnp.uint8)[None, :]) & 1
+    return bits.reshape(-1)[:n].astype(jnp.bool_)
+
+
+def pack_bool_matrix(valid: jnp.ndarray) -> jnp.ndarray:
+    """Pack a bool matrix [rows, cols] into row-validity bytes [rows, ⌈cols/8⌉].
+
+    This is the TPU replacement for the reference's per-warp ballot transpose
+    (``row_conversion.cu:748-778``): each output byte holds the validity bits
+    of 8 consecutive columns of one row, bit i = column ``byte*8 + i``
+    (matching the JCUDF validity byte layout, ``RowConversion.java:56-58``).
+    """
+    rows, cols = valid.shape
+    nbytes = -(-cols // 8)
+    padded = jnp.zeros((rows, nbytes * 8), dtype=jnp.uint8).at[:, :cols].set(
+        valid.astype(jnp.uint8))
+    return (padded.reshape(rows, nbytes, 8) * jnp.asarray(_BIT_WEIGHTS)).sum(
+        axis=2, dtype=jnp.uint8)
+
+
+def unpack_bool_matrix(row_bytes: jnp.ndarray, cols: int) -> jnp.ndarray:
+    """Inverse of :func:`pack_bool_matrix`: [rows, ⌈cols/8⌉] → bool [rows, cols]."""
+    rows = row_bytes.shape[0]
+    bits = (row_bytes[:, :, None] >> jnp.arange(8, dtype=jnp.uint8)[None, None, :]) & 1
+    return bits.reshape(rows, -1)[:, :cols].astype(jnp.bool_)
+
+
+# numpy twins (host-side oracle / test reference)
+
+def pack_bits_np(valid: np.ndarray) -> np.ndarray:
+    return np.packbits(np.asarray(valid, dtype=np.uint8), bitorder="little")
+
+
+def unpack_bits_np(mask: np.ndarray, n: int) -> np.ndarray:
+    return np.unpackbits(np.asarray(mask, dtype=np.uint8),
+                         count=n, bitorder="little").astype(bool)

@@ -1,0 +1,44 @@
+"""Tracing / profiling hooks.
+
+The reference instruments every public entry with NVTX ranges
+(``CUDF_FUNC_RANGE()`` at ``NativeParquetJni.cpp:136,392,469,524,553,578,668``)
+and exposes a Java-side toggle (``pom.xml:86,490``).  The TPU-native
+equivalents are ``jax.named_scope`` (shows up in XLA HLO + xprof) and
+``jax.profiler`` trace annotations; both degrade to no-ops off-device.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+
+import jax
+
+_ENABLED = os.environ.get("SPARK_RAPIDS_TPU_TRACE", "1") not in ("0", "false")
+
+
+@contextlib.contextmanager
+def func_range(name: str):
+    """NVTX-range analog: a named scope visible in HLO and xprof traces."""
+    if not _ENABLED:
+        yield
+        return
+    with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def traced(name: str | None = None):
+    """Decorator form of :func:`func_range` (CUDF_FUNC_RANGE analog)."""
+
+    def wrap(fn):
+        scope = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            with func_range(scope):
+                return fn(*args, **kwargs)
+
+        return inner
+
+    return wrap

@@ -1,0 +1,23 @@
+"""Test harness config: run everything on a virtual 8-device CPU mesh.
+
+Multi-chip hardware is not available in CI; sharding paths are validated on
+``--xla_force_host_platform_device_count=8`` per the project test strategy
+(the driver separately dry-run-compiles the multichip path via
+``__graft_entry__.dryrun_multichip``).
+
+The environment may pre-register a TPU PJRT plugin via sitecustomize and pin
+``JAX_PLATFORMS``; ``jax.config.update`` after import wins over both, as long
+as it runs before the backend is initialized (hence this top-level conftest).
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,39 @@
+import numpy as np
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.utils import bitmask
+
+
+def test_pack_bits_matches_numpy_packbits():
+    rng = np.random.default_rng(0)
+    for n in (1, 7, 8, 9, 63, 64, 1000):
+        v = rng.random(n) < 0.5
+        got = np.asarray(bitmask.pack_bits(jnp.asarray(v)))
+        np.testing.assert_array_equal(got, bitmask.pack_bits_np(v))
+
+
+def test_unpack_roundtrip():
+    rng = np.random.default_rng(1)
+    for n in (3, 8, 17, 256):
+        v = rng.random(n) < 0.3
+        packed = bitmask.pack_bits(jnp.asarray(v))
+        back = np.asarray(bitmask.unpack_bits(packed, n))
+        np.testing.assert_array_equal(back, v)
+
+
+def test_pack_bool_matrix_bit_order():
+    # bit i of byte b == column b*8+i (RowConversion.java:56-58)
+    v = np.zeros((2, 10), dtype=bool)
+    v[0, 0] = True   # byte0 bit0
+    v[0, 9] = True   # byte1 bit1
+    v[1, 7] = True   # byte0 bit7
+    got = np.asarray(bitmask.pack_bool_matrix(jnp.asarray(v)))
+    np.testing.assert_array_equal(got, [[1, 2], [128, 0]])
+
+
+def test_pack_unpack_matrix_roundtrip():
+    rng = np.random.default_rng(2)
+    v = rng.random((37, 21)) < 0.5
+    packed = bitmask.pack_bool_matrix(jnp.asarray(v))
+    back = np.asarray(bitmask.unpack_bool_matrix(packed, 21))
+    np.testing.assert_array_equal(back, v)

@@ -1,0 +1,53 @@
+import numpy as np
+import pytest
+
+import spark_rapids_jni_tpu as sr
+from spark_rapids_jni_tpu import Column, Table
+
+
+def test_fixed_width_column_roundtrip():
+    arr = np.asarray([1, -2, 3], dtype=np.int32)
+    c = Column.from_numpy(arr)
+    assert c.dtype == sr.int32
+    assert c.num_rows == 3 and c.null_count == 0
+    np.testing.assert_array_equal(c.to_numpy(), arr)
+
+
+def test_bool_column_stored_as_byte():
+    c = Column.from_numpy(np.asarray([True, False, True]))
+    assert c.dtype == sr.bool8
+    assert c.data.dtype == np.uint8
+    assert c.to_pylist() == [True, False, True]
+
+
+def test_validity_and_null_count():
+    c = Column.from_numpy(np.asarray([1, 2, 3, 4], dtype=np.int64),
+                          validity=np.asarray([True, False, True, False]))
+    assert c.null_count == 2
+    assert c.to_pylist() == [1, None, 3, None]
+    np.testing.assert_array_equal(np.asarray(c.validity_bitmask()), [0b0101])
+
+
+def test_string_column():
+    c = Column.strings_from_list(["hello", "", None, "wörld"])
+    assert c.dtype == sr.string
+    assert c.num_rows == 4
+    assert c.null_count == 1
+    assert c.to_pylist() == ["hello", "", None, "wörld"]
+
+
+def test_table_basics_and_mismatch():
+    t = Table.from_pydict({"a": [1, 2, 3], "s": ["x", "y", None]})
+    assert t.num_columns == 2 and t.num_rows == 3
+    assert t.schema[1] == sr.string
+    with pytest.raises(ValueError):
+        Table([Column.from_numpy(np.zeros(2, np.int32)),
+               Column.from_numpy(np.zeros(3, np.int32))])
+
+
+def test_table_is_a_pytree():
+    import jax
+    t = Table.from_pydict({"a": [1, 2, 3]})
+    t2 = jax.tree_util.tree_map(lambda x: x, t)
+    assert isinstance(t2, Table)
+    np.testing.assert_array_equal(t2[0].to_numpy(), t[0].to_numpy())
