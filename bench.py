@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Headline benchmark: JCUDF row-conversion round trip on TPU vs CPU baseline.
+
+BASELINE.md staged config #1: "row_conversion round-trip micro-op (1M-row
+int64 batch, CPU ref)".  Mirrors the reference's nvbench axes in spirit
+(``benchmarks/row_conversion.cpp:27-67``: N-row cycled fixed-width schema ×
+{to row, from row}, reporting memory throughput).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+
+value        = bytes transcoded per second through the device path, counting
+               the JCUDF row bytes once per direction (to_rows + from_rows).
+vs_baseline  = device GB/s / vectorized-NumPy-host GB/s on the same workload.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import spark_rapids_jni_tpu as sr
+from spark_rapids_jni_tpu import Column, Table, convert_to_rows, convert_from_rows
+from spark_rapids_jni_tpu.rowconv import host as host_engine
+
+N_ROWS = 1_000_000
+# 12-column cycled fixed-width schema (int64-heavy per BASELINE config #1;
+# f64 excluded: its payload legitimately stages via host on TPU and would
+# turn this into a transfer benchmark).
+SCHEMA_CYCLE = [sr.int64, sr.int32, sr.int16, sr.int8, sr.float32, sr.bool8]
+N_COLS = 12
+WARMUP, ITERS = 2, 5
+
+
+def build_table(n_rows: int) -> Table:
+    rng = np.random.default_rng(7)
+    cols = []
+    for i in range(N_COLS):
+        dt = SCHEMA_CYCLE[i % len(SCHEMA_CYCLE)]
+        if dt.storage.kind == "f":
+            arr = rng.standard_normal(n_rows).astype(dt.storage)
+        elif dt == sr.bool8:
+            arr = rng.integers(0, 2, n_rows).astype(np.uint8)
+        else:
+            info = np.iinfo(dt.storage)
+            arr = rng.integers(info.min // 2, info.max // 2, n_rows,
+                               dtype=dt.storage)
+        validity = rng.random(n_rows) < 0.9 if i % 3 == 0 else None
+        cols.append(Column.from_numpy(arr, dt, validity))
+    return Table(cols)
+
+
+def time_device(table: Table) -> tuple[float, int]:
+    def roundtrip():
+        batch = convert_to_rows(table)[0]
+        back = convert_from_rows(batch, table.schema)
+        jax.block_until_ready([c.data for c in back.columns])
+        return batch
+
+    for _ in range(WARMUP):
+        batch = roundtrip()
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        batch = roundtrip()
+    dt = (time.perf_counter() - t0) / ITERS
+    return dt, batch.num_bytes
+
+
+def time_host(table: Table) -> float:
+    def roundtrip():
+        rows = host_engine.to_rows_fixed_np(table)
+        host_engine.from_rows_fixed_np(rows, table.schema)
+        return rows
+
+    roundtrip()
+    t0 = time.perf_counter()
+    for _ in range(max(1, ITERS // 2)):
+        roundtrip()
+    return (time.perf_counter() - t0) / max(1, ITERS // 2)
+
+
+def main():
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else N_ROWS
+    table = build_table(n_rows)
+
+    dev_s, row_bytes = time_device(table)
+    host_s = time_host(table)
+
+    transcoded = 2 * row_bytes  # row bytes once per direction
+    dev_gbps = transcoded / dev_s / 1e9
+    host_gbps = transcoded / host_s / 1e9
+
+    print(json.dumps({
+        "metric": "jcudf_row_conversion_roundtrip_1M",
+        "value": round(dev_gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(dev_gbps / host_gbps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

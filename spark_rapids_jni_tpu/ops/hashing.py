@@ -1,0 +1,92 @@
+"""Murmur3 x86_32 hashing — Spark-compatible, vectorized for the VPU.
+
+The reference delegates hashing to libcudf (SURVEY §2.9); Spark's shuffle
+partitioner uses Murmur3 x86_32 with seed 42 over the row's bytes, treating
+ints as one 4-byte block and longs as two 4-byte blocks (low word first).
+This is a lane-parallel reimplementation of the public MurmurHash3 algorithm
+(Austin Appleby, public domain) in jnp uint32 arithmetic — every row hashes in
+registers, no byte loops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_SEED = np.uint32(42)  # Spark's Murmur3Hash seed
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+
+
+def _rotl32(x, r):
+    return (x << r) | (x >> (32 - r))
+
+
+def _mix_k(k):
+    k = (k * _C1).astype(jnp.uint32)
+    k = _rotl32(k, 15)
+    return (k * _C2).astype(jnp.uint32)
+
+
+def _mix_h(h, k):
+    h = h ^ _mix_k(k)
+    h = _rotl32(h, 13)
+    return (h * np.uint32(5) + np.uint32(0xE6546B64)).astype(jnp.uint32)
+
+
+def _fmix(h):
+    h = h ^ (h >> 16)
+    h = (h * np.uint32(0x85EBCA6B)).astype(jnp.uint32)
+    h = h ^ (h >> 13)
+    h = (h * np.uint32(0xC2B2AE35)).astype(jnp.uint32)
+    return h ^ (h >> 16)
+
+
+def murmur3_32(values: jnp.ndarray,
+               seed: np.uint32 = DEFAULT_SEED) -> jnp.ndarray:
+    """Hash an integer array per element; returns uint32 [n].
+
+    int8/16/32 hash as one 4-byte block (sign-extended to 32 bits, as Spark
+    does); int64/uint64 as two 4-byte blocks, low word first.
+    """
+    dt = values.dtype
+    if dt.kind == "f":
+        # Spark hashes floats by their Java floatToIntBits pattern, with
+        # -0.0 normalized to 0.0 and NaN canonicalized.  f64 has no device
+        # bit access on TPU (see rowconv/convert.py), so only f32 here.
+        if dt.itemsize != 4:
+            raise TypeError(
+                "murmur3_32: float64 keys are not hashable on device "
+                "(no f64 bit access on TPU); cast or hash on host")
+        v = jnp.where(values == 0.0, jnp.float32(0.0), values)
+        bits = jax.lax.bitcast_convert_type(v, jnp.uint32)
+        values = jnp.where(jnp.isnan(v), jnp.uint32(0x7FC00000), bits)
+        dt = values.dtype
+    elif dt.kind == "b":
+        values = values.astype(jnp.int32)
+        dt = values.dtype
+    elif dt.kind not in ("i", "u"):
+        raise TypeError(f"murmur3_32: unsupported key dtype {dt}")
+
+    h = jnp.full(values.shape, seed, dtype=jnp.uint32)
+    if dt.itemsize <= 4:
+        block = values.astype(jnp.int32).view(jnp.uint32) \
+            if dt != jnp.uint32 else values
+        h = _mix_h(h, block)
+        length = np.uint32(4)
+    else:
+        v = values.view(jnp.uint64) if dt == jnp.int64 else values
+        lo = (v & np.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (v >> np.uint64(32)).astype(jnp.uint32)
+        h = _mix_h(h, lo)
+        h = _mix_h(h, hi)
+        length = np.uint32(8)
+    return _fmix(h ^ length)
+
+
+def hash_partition(hashes: jnp.ndarray, num_partitions: int) -> jnp.ndarray:
+    """Spark-style non-negative modulo partitioning → int32 [n] in [0, P)."""
+    m = (hashes.astype(jnp.int32) % np.int32(num_partitions)).astype(jnp.int32)
+    return jnp.where(m < 0, m + num_partitions, m)

@@ -1,0 +1,2 @@
+from .mesh import make_mesh  # noqa: F401
+from .shuffle import bucketize_rows, all_to_all_shuffle  # noqa: F401

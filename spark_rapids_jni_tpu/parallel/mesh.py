@@ -1,0 +1,26 @@
+"""Device-mesh helpers.
+
+The reference's distribution story is host-mediated (SURVEY §2 parallelism
+note): it emits shuffle-ready JCUDF blobs and lets Spark's external
+UCX/NVLink RapidsShuffle move them.  Here the transport is first-class: a
+`jax.sharding.Mesh` over ICI/DCN with XLA collectives (the BASELINE.json
+north-star "RapidsShuffle over ICI").
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def make_mesh(n_devices: int | None = None,
+              axis_name: str = "data") -> jax.sharding.Mesh:
+    """1-D mesh over the first ``n_devices`` devices (executor-pool analog)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devs)} "
+                "(tests use --xla_force_host_platform_device_count)")
+        devs = devs[:n_devices]
+    return jax.sharding.Mesh(np.array(devs), (axis_name,))

@@ -1,0 +1,81 @@
+"""Hash shuffle of JCUDF row blobs over the device mesh (ICI all-to-all).
+
+TPU-native replacement for the external RapidsShuffle UCX/NVLink path the
+reference feeds (SURVEY §5.8): rows are partitioned by key hash, bucketized
+into fixed-capacity per-destination buckets (XLA needs static shapes — the
+dynamic part is carried as per-bucket counts), and exchanged with
+``lax.all_to_all`` inside ``shard_map`` so XLA rides ICI.
+
+Capacity discipline: like the reference's ≤2GB row batches
+(``row_conversion.cu:97-103``), senders bound their per-destination payload;
+rows beyond ``capacity`` are counted in ``dropped`` (callers size capacity
+with headroom and treat dropped > 0 as an error/retry-with-larger-capacity —
+a size pass, same two-phase discipline as the string path).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Buckets(NamedTuple):
+    rows: jnp.ndarray      # [P, capacity, row_size]
+    counts: jnp.ndarray    # int32 [P] — valid rows per bucket (≤ capacity)
+    dropped: jnp.ndarray   # int32 [] — rows that exceeded capacity
+
+
+def bucketize_rows(rows: jnp.ndarray, part_id: jnp.ndarray,
+                   num_partitions: int, capacity: int) -> Buckets:
+    """Group local rows by destination partition into padded buckets.
+
+    rows: [n, row_size] (any dtype); part_id: int32 [n] in [0, P).
+    Pure static-shape formulation: stable-sort by partition, compute each
+    row's rank within its partition, scatter with out-of-range drop.
+    """
+    n, row_size = rows.shape
+    # out-of-range destinations (partitioner bugs) are routed to a sentinel
+    # partition P and counted in `dropped` — without this, a negative id
+    # would wrap via negative indexing into partition P-1
+    in_range = (part_id >= 0) & (part_id < num_partitions)
+    part_id = jnp.where(in_range, part_id, num_partitions).astype(jnp.int32)
+
+    order = jnp.argsort(part_id, stable=True)
+    sorted_rows = rows[order]
+    sorted_part = part_id[order]
+    counts = jnp.zeros(num_partitions, dtype=jnp.int32).at[part_id].add(
+        1, mode="drop")  # sentinel P drops out rather than clipping to P-1
+    starts = jnp.cumsum(counts) - counts
+    rank = jnp.arange(n, dtype=jnp.int32) - starts.at[sorted_part].get(
+        mode="fill", fill_value=0)
+
+    buckets = jnp.zeros((num_partitions, capacity, row_size), dtype=rows.dtype)
+    # sentinel partition and ranks ≥ capacity fall outside the scatter
+    # domain and are dropped
+    buckets = buckets.at[sorted_part, rank].set(sorted_rows, mode="drop")
+    clipped = jnp.minimum(counts, capacity)
+    dropped = np.int32(n) - clipped.sum()
+    return Buckets(buckets, clipped, dropped)
+
+
+def all_to_all_shuffle(buckets: Buckets, axis_name: str) -> Buckets:
+    """Exchange buckets across the mesh axis (must run inside shard_map).
+
+    After the exchange, ``rows[p]`` holds the rows device ``p`` addressed to
+    this device, with ``counts[p]`` of them valid.
+    """
+    rows = jax.lax.all_to_all(buckets.rows, axis_name, split_axis=0,
+                              concat_axis=0, tiled=True)
+    counts = jax.lax.all_to_all(buckets.counts, axis_name, split_axis=0,
+                                concat_axis=0, tiled=True)
+    return Buckets(rows, counts, buckets.dropped)
+
+
+def received_mask(buckets: Buckets) -> jnp.ndarray:
+    """bool [P, capacity]: which received slots hold real rows."""
+    capacity = buckets.rows.shape[1]
+    return (jnp.arange(capacity, dtype=jnp.int32)[None, :]
+            < buckets.counts[:, None])

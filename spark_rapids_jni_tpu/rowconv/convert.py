@@ -370,11 +370,13 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
 
 
 def _assemble(schema, datas, valid, chars, out_offsets) -> Table:
-    valid_np = np.asarray(valid)
+    # Validity stays on device: the reference likewise always materializes a
+    # null mask on this path ("always add it in", row_conversion.cu:1299-1301);
+    # deciding all-valid here would force a D2H sync per conversion.
     cols = []
     vi = 0
     for ci, dt in enumerate(schema):
-        v = None if valid_np[:, ci].all() else jnp.asarray(valid_np[:, ci])
+        v = valid[:, ci]
         if dt.is_variable_width:
             cols.append(Column(dt, chars[vi], out_offsets[vi], v))
             vi += 1

@@ -1,0 +1,90 @@
+"""Murmur3 x86_32 tests: vectorized JAX implementation vs an independent
+scalar implementation written directly from the public MurmurHash3 spec."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.ops import hashing
+
+
+def _scalar_murmur3_bytes(data: bytes, seed: int) -> int:
+    """Scalar MurmurHash3 x86_32 (public-domain algorithm, Austin Appleby)."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    mask = 0xFFFFFFFF
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (32 - r))) & mask
+
+    h = seed & mask
+    nblocks = len(data) // 4
+    for i in range(nblocks):
+        k = int.from_bytes(data[4 * i:4 * i + 4], "little")
+        k = (k * c1) & mask
+        k = rotl(k, 15)
+        k = (k * c2) & mask
+        h ^= k
+        h = rotl(h, 13)
+        h = (h * 5 + 0xE6546B64) & mask
+    # (no tail for 4/8-byte keys)
+    h ^= len(data)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & mask
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & mask
+    h ^= h >> 16
+    return h
+
+
+def test_murmur3_int32_matches_scalar_spec():
+    vals = np.asarray([0, 1, -1, 42, 2**31 - 1, -2**31], dtype=np.int32)
+    got = np.asarray(hashing.murmur3_32(jnp.asarray(vals)))
+    for v, g in zip(vals, got):
+        expect = _scalar_murmur3_bytes(
+            int(v).to_bytes(4, "little", signed=True), 42)
+        assert int(g) == expect, v
+
+
+def test_murmur3_int64_matches_scalar_spec():
+    vals = np.asarray([0, 1, -1, 2**40, -2**40, 2**63 - 1], dtype=np.int64)
+    got = np.asarray(hashing.murmur3_32(jnp.asarray(vals)))
+    for v, g in zip(vals, got):
+        expect = _scalar_murmur3_bytes(
+            int(v).to_bytes(8, "little", signed=True), 42)
+        assert int(g) == expect, v
+
+
+def test_small_ints_sign_extend_like_spark():
+    # Spark hashes ByteType/ShortType by sign-extending to a 4-byte int
+    a = np.asarray(hashing.murmur3_32(jnp.asarray(np.asarray([-3], np.int8))))
+    b = np.asarray(hashing.murmur3_32(jnp.asarray(np.asarray([-3], np.int32))))
+    assert a[0] == b[0]
+
+
+def test_hash_partition_non_negative_and_stable():
+    h = hashing.murmur3_32(jnp.arange(1000, dtype=jnp.int64))
+    p = np.asarray(hashing.hash_partition(h, 8))
+    assert p.min() >= 0 and p.max() < 8
+    # roughly uniform: each partition gets something
+    assert len(np.unique(p)) == 8
+
+
+def test_float32_hashes_by_bit_pattern_with_spark_normalization():
+    import struct
+    vals = np.asarray([1.5, -0.0, 0.0, np.nan, np.inf], dtype=np.float32)
+    got = np.asarray(hashing.murmur3_32(jnp.asarray(vals)))
+    def bits(f):
+        if np.isnan(f):
+            return 0x7FC00000
+        if f == 0.0:
+            f = 0.0  # -0.0 normalized
+        return struct.unpack("<I", struct.pack("<f", f))[0]
+    for v, g in zip(vals, got):
+        expect = _scalar_murmur3_bytes(int(bits(v)).to_bytes(4, "little"), 42)
+        assert int(g) == expect, v
+    assert got[1] == got[2]  # -0.0 == 0.0
+
+
+def test_float64_keys_rejected():
+    import pytest
+    with pytest.raises(TypeError, match="float64"):
+        hashing.murmur3_32(jnp.asarray(np.asarray([1.0], dtype=np.float64)))
