@@ -54,18 +54,19 @@ def build_table(n_rows: int) -> Table:
 
 def time_device(table: Table) -> tuple[float, int]:
     def roundtrip():
-        batch = convert_to_rows(table)[0]
-        back = convert_from_rows(batch, table.schema)
-        jax.block_until_ready([c.data for c in back.columns])
-        return batch
+        batches = convert_to_rows(table)
+        for batch in batches:  # decode every batch so bytes match the timing
+            back = convert_from_rows(batch, table.schema)
+            jax.block_until_ready([c.data for c in back.columns])
+        return sum(b.num_bytes for b in batches)
 
     for _ in range(WARMUP):
-        batch = roundtrip()
+        total_bytes = roundtrip()
     t0 = time.perf_counter()
     for _ in range(ITERS):
-        batch = roundtrip()
+        total_bytes = roundtrip()
     dt = (time.perf_counter() - t0) / ITERS
-    return dt, batch.num_bytes
+    return dt, total_bytes
 
 
 def time_host(table: Table) -> float:

@@ -1,0 +1,4 @@
+from .footer import (  # noqa: F401
+    ParquetFooter, StructElement, ValueElement, ListElement, MapElement,
+    read_and_filter,
+)

@@ -1,0 +1,142 @@
+"""ctypes binding to the native (C++) footer engine.
+
+Loads ``native/libsrjt_parquet.so`` (building it with ``make`` on first use
+if a toolchain is present) and exposes the same API as ``footer.py``.  The
+handle-based C ABI mirrors the reference's JNI jlong-handle protocol
+(``NativeParquetJni.cpp:568-666``): read_and_filter → handle; num_rows /
+num_columns / serialize / free operate on the handle.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from .footer import SchemaNode
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libsrjt_parquet.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR, "-s"], check=True,
+                       capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.srjt_footer_read_and_filter.restype = ctypes.c_void_p
+        lib.srjt_footer_read_and_filter.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_char_p, ctypes.c_uint64]
+        lib.srjt_footer_num_rows.restype = ctypes.c_int64
+        lib.srjt_footer_num_rows.argtypes = [ctypes.c_void_p]
+        lib.srjt_footer_num_columns.restype = ctypes.c_int64
+        lib.srjt_footer_num_columns.argtypes = [ctypes.c_void_p]
+        lib.srjt_footer_serialize.restype = ctypes.c_int64
+        lib.srjt_footer_serialize.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64]
+        lib.srjt_footer_free.restype = None
+        lib.srjt_footer_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class NativeParquetFooter:
+    """Owning wrapper over a native footer handle (AutoCloseable analog,
+    ParquetFooter.java:27,124-130)."""
+
+    def __init__(self, handle: int, lib: ctypes.CDLL):
+        self._handle = handle
+        self._lib = lib
+
+    @property
+    def num_rows(self) -> int:
+        self._check()
+        return self._lib.srjt_footer_num_rows(self._handle)
+
+    @property
+    def num_columns(self) -> int:
+        self._check()
+        return self._lib.srjt_footer_num_columns(self._handle)
+
+    def serialize_thrift_file(self) -> bytes:
+        self._check()
+        err = ctypes.create_string_buffer(512)
+        size = self._lib.srjt_footer_serialize(self._handle, None, 0, err, 512)
+        if size < 0:
+            raise RuntimeError(err.value.decode())
+        buf = ctypes.create_string_buffer(size)
+        got = self._lib.srjt_footer_serialize(self._handle, buf, size, err, 512)
+        if got < 0:
+            raise RuntimeError(err.value.decode())
+        return buf.raw[:got]
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.srjt_footer_free(self._handle)
+            self._handle = 0
+
+    def _check(self):
+        if not self._handle:
+            raise ValueError("footer already closed")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def read_and_filter(buf: bytes, part_offset: int, part_length: int,
+                    schema: SchemaNode,
+                    ignore_case: bool = False) -> NativeParquetFooter:
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native parquet engine not available (build failed)")
+    names, num_children, tags = schema.flatten_depth_first()
+    n = len(names)
+    names_arr = (ctypes.c_char_p * n)(*[s.encode("utf-8") for s in names])
+    nc_arr = (ctypes.c_int32 * n)(*num_children)
+    tags_arr = (ctypes.c_int32 * n)(*tags)
+    err = ctypes.create_string_buffer(512)
+    handle = lib.srjt_footer_read_and_filter(
+        buf, len(buf), part_offset, part_length, names_arr, nc_arr, tags_arr,
+        n, len(schema.children), 1 if ignore_case else 0, err, 512)
+    if not handle:
+        raise ValueError(f"footer read/filter failed: {err.value.decode()}")
+    return NativeParquetFooter(handle, lib)

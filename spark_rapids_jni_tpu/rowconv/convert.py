@@ -221,8 +221,13 @@ def _gather_var_slots(layout: RowLayout, data: jnp.ndarray,
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def _from_rows_var(layout: RowLayout, char_totals: tuple[int, ...],
                    data: jnp.ndarray, row_offsets: jnp.ndarray,
-                   out_offsets: tuple[jnp.ndarray, ...]):
-    """Phase 2: gather fixed slots, validity, and chars buffers."""
+                   out_offsets: tuple[jnp.ndarray, ...],
+                   slots: tuple[jnp.ndarray, ...]):
+    """Phase 2: gather fixed slots, validity, and chars buffers.
+
+    ``slots`` are the phase-1 (offset,len) uint32 pairs from
+    ``_gather_var_slots`` — passed through rather than re-read from the row
+    bytes."""
     row_base = row_offsets[:-1].astype(jnp.int64)
     n = row_base.shape[0]
 
@@ -246,10 +251,7 @@ def _from_rows_var(layout: RowLayout, char_totals: tuple[int, ...],
     for vi, ci in enumerate(layout.variable_column_indices):
         total = char_totals[vi]
         offs = out_offsets[vi].astype(jnp.int64)            # [n+1]
-        start = layout.column_starts[ci]
-        pos = row_base[:, None] + start + jnp.arange(8)[None, :]
-        slot = jax.lax.bitcast_convert_type(
-            data[pos.reshape(-1)].reshape(n, 2, 4), jnp.uint32)
+        slot = slots[vi]
         src_base = row_base + slot[:, 0].astype(jnp.int64)  # chars start per row
         if total == 0:
             chars_out.append(jnp.zeros((0,), dtype=jnp.uint8))
@@ -364,7 +366,7 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
         char_totals.append(int(offs[-1]))
     datas, valid, chars = _from_rows_var(
         layout, tuple(char_totals), batch.data, row_offsets,
-        tuple(out_offsets))
+        tuple(out_offsets), slots)
     return _assemble(schema, datas, valid, chars,
                      [o.astype(jnp.int32) for o in out_offsets])
 

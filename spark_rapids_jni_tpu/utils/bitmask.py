@@ -59,9 +59,9 @@ def pack_bool_matrix(valid: jnp.ndarray) -> jnp.ndarray:
 
 def unpack_bool_matrix(row_bytes: jnp.ndarray, cols: int) -> jnp.ndarray:
     """Inverse of :func:`pack_bool_matrix`: [rows, ⌈cols/8⌉] → bool [rows, cols]."""
-    rows = row_bytes.shape[0]
+    rows, nbytes = row_bytes.shape
     bits = (row_bytes[:, :, None] >> jnp.arange(8, dtype=jnp.uint8)[None, None, :]) & 1
-    return bits.reshape(rows, -1)[:, :cols].astype(jnp.bool_)
+    return bits.reshape(rows, nbytes * 8)[:, :cols].astype(jnp.bool_)
 
 
 # numpy twins (host-side oracle / test reference)

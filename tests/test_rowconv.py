@@ -185,3 +185,17 @@ def test_many_strings_mixed():
 def test_empty_strings_only():
     c = Column.strings_from_list(["", "", ""])
     roundtrip_and_differential(Table([c, random_column(sr.int8, 3)]))
+
+
+def test_zero_row_roundtrip():
+    # empty partitions are routine in Spark shuffles
+    t = Table([Column.from_numpy(np.zeros(0, np.int32)),
+               Column.from_numpy(np.zeros(0, np.int64))])
+    batches = convert_to_rows(t)
+    back = convert_from_rows(batches[0], t.schema)
+    assert back.num_rows == 0
+    ts = Table([Column.strings_from_list([]),
+                Column.from_numpy(np.zeros(0, np.int16))])
+    batches = convert_to_rows(ts)
+    back = convert_from_rows(batches[0], ts.schema)
+    assert back.num_rows == 0
