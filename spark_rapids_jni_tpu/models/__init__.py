@@ -1,0 +1,9 @@
+"""Query pipelines — the framework's "model zoo".
+
+The reference framework's unit of deployment is a Spark query plan; these
+modules are end-to-end pipelines matching BASELINE.md's staged configs
+(q6 = config #2), each a jittable scan→filter→aggregate program over the
+columnar op library.
+"""
+
+from . import q6  # noqa: F401

@@ -1,0 +1,66 @@
+"""Column casts (numeric, bool, decimal rescale, timestamps).
+
+Replaces the slice of libcudf's cast kernels the Spark plugin leans on
+(SURVEY §2.9 / §7 step 6).  TPU-first: every cast is a single fused
+elementwise XLA op over the column's lanes; validity rides along untouched.
+
+Decimal semantics follow the reference's representation (scaled integers,
+``RowConversion.java:114-118``): DECIMAL(s) holds ``unscaled * 10**s`` with
+cudf's negative-scale convention, so rescaling from s1 to s2 multiplies or
+divides by ``10**(s1 - s2)`` (round-half-up on divide, matching Spark).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..column import Column
+
+
+def cast(col: Column, to: T.DType) -> Column:
+    """Cast a column to another dtype, preserving validity."""
+    src = col.dtype
+    if src == to:
+        return col
+    if src.id == T.TypeId.STRING or to.id == T.TypeId.STRING:
+        raise NotImplementedError("string casts live in ops.strings")
+
+    data = col.data
+    if src.is_decimal and to.is_decimal:
+        data = _rescale(data, src.scale, to.scale).astype(to.storage)
+    elif src.is_decimal:
+        # decimal → float/int: apply the scale
+        if to.storage.kind == "f":
+            data = data.astype(to.storage) * np.float64(10.0) ** src.scale
+        else:
+            data = _rescale(data, src.scale, 0).astype(to.storage)
+    elif to.is_decimal:
+        if src.storage.kind == "f":
+            scaled = data.astype(jnp.float64) * np.float64(10.0) ** (-to.scale)
+            data = jnp.round(scaled).astype(to.storage)
+        else:
+            data = _rescale(data.astype(jnp.int64), 0, to.scale).astype(to.storage)
+    elif src.id == T.TypeId.BOOL8:
+        data = (data != 0).astype(to.storage)
+    elif to.id == T.TypeId.BOOL8:
+        data = (data != 0).astype(jnp.uint8)
+    else:
+        data = data.astype(to.storage)
+    return Column(to, data, validity=col.validity)
+
+
+def _rescale(data: jnp.ndarray, from_scale: int, to_scale: int) -> jnp.ndarray:
+    """unscaled * 10**from_scale == result * 10**to_scale."""
+    diff = from_scale - to_scale
+    if diff == 0:
+        return data
+    if diff > 0:
+        return data * np.int64(10) ** diff
+    div = np.int64(10) ** (-diff)
+    # round half away from zero, like Spark's decimal rescale (floor division
+    # on a negative adjusted value would over-round, so work on magnitudes)
+    half = div // 2
+    mag = (jnp.abs(data) + half) // div
+    return jnp.where(data < 0, -mag, mag)

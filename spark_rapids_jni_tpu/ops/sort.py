@@ -1,0 +1,48 @@
+"""Multi-key table sort (libcudf sort/order_by analog).
+
+TPU-first: ``jnp.lexsort`` (XLA's variadic sort) over the key columns —
+no comparator kernels.  Nulls order first or last per key via an explicit
+null-rank lane prepended to that key, matching Spark's NULLS FIRST/LAST.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from ..column import Table
+from .filter import gather
+
+
+def order_by(table: Table, keys: Sequence[int],
+             ascending: Sequence[bool] | None = None,
+             nulls_first: Sequence[bool] | None = None) -> jnp.ndarray:
+    """Row ordering by the given key column indices (first key is primary)."""
+    ascending = list(ascending) if ascending else [True] * len(keys)
+    nulls_first = list(nulls_first) if nulls_first else [True] * len(keys)
+
+    lanes = []
+    # lexsort sorts by the LAST key first → feed keys in reverse priority
+    for ki, asc, nf in reversed(list(zip(keys, ascending, nulls_first))):
+        col = table[ki]
+        data = col.data
+        if col.dtype.id.name == "STRING":
+            raise NotImplementedError("string sort keys: ops.strings")
+        if not asc:
+            data = -data if data.dtype.kind == "f" else ~data  # order-reversing
+        if col.validity is not None:
+            # the rank lane always sorts ascending, independent of the data
+            # lane's direction: 0 → nulls first, 2 → nulls last
+            null_rank = jnp.where(col.validity, 1, 0 if nf else 2)
+            lanes.append(data)
+            lanes.append(null_rank)   # appended after → higher priority
+        else:
+            lanes.append(data)
+    return jnp.lexsort(tuple(lanes))
+
+
+def sort_table(table: Table, keys: Sequence[int],
+               ascending: Sequence[bool] | None = None,
+               nulls_first: Sequence[bool] | None = None) -> Table:
+    return gather(table, order_by(table, keys, ascending, nulls_first))

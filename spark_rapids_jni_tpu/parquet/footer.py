@@ -149,12 +149,18 @@ class ColumnPruner:
 
     @classmethod
     def from_flat(cls, names: Sequence[str], num_children: Sequence[int],
-                  tags: Sequence[int], parent_num_children: int):
+                  tags: Sequence[int], parent_num_children: int,
+                  fold_case: bool = False):
+        """``fold_case`` lowercases the expected names so they can match the
+        case-folded footer names — the reference folds both sides (the Java
+        caller folds the expected names, the C++ side folds the footer's)."""
         root = cls(TAG_STRUCT)
         if parent_num_children == 0:
             return root
         stack = [(root, parent_num_children)]
         for name, n_c, t in zip(names, num_children, tags):
+            if fold_case:
+                name = name.lower()
             node = cls(t)
             stack[-1][0].children[name] = node
             if n_c > 0:
@@ -170,9 +176,10 @@ class ColumnPruner:
         return root
 
     @classmethod
-    def from_tree(cls, root: SchemaNode):
+    def from_tree(cls, root: SchemaNode, fold_case: bool = False):
         names, num_children, tags = root.flatten_depth_first()
-        return cls.from_flat(names, num_children, tags, len(root.children))
+        return cls.from_flat(names, num_children, tags, len(root.children),
+                             fold_case)
 
     # -- matching -----------------------------------------------------------
     def filter_schema(self, schema: list[Struct], ignore_case: bool) -> PruningMaps:
@@ -407,8 +414,10 @@ def read_and_filter(buf: bytes, part_offset: int, part_length: int,
     (NativeParquetJni.cpp:568-626).  ``part_length < 0`` keeps all groups.
     """
     meta = parse_struct(buf)
-    pruner = ColumnPruner.from_tree(schema)
+    pruner = ColumnPruner.from_tree(schema, fold_case=ignore_case)
     schema_list = meta.get(FMD.SCHEMA)
+    if schema_list is None:
+        raise ValueError("footer has no schema")
     maps = pruner.filter_schema(schema_list.values, ignore_case)
 
     # gather + rewrite schema num_children
@@ -427,11 +436,12 @@ def read_and_filter(buf: bytes, part_offset: int, part_length: int,
         meta.get_field(FMD.COLUMN_ORDERS).value = ListValue(
             orders.elem_type, [orders.values[i] for i in maps.chunk_map])
 
-    if part_length >= 0:
+    groups_field = meta.get_field(FMD.ROW_GROUPS)
+    if part_length >= 0 and groups_field is not None:
         kept = filter_groups(meta, part_offset, part_length)
-        meta.get_field(FMD.ROW_GROUPS).value = ListValue(TType.STRUCT, kept)
-    groups = meta.get(FMD.ROW_GROUPS)
-    filter_columns(groups.values if groups else [], maps.chunk_map)
+        groups_field.value = ListValue(TType.STRUCT, kept)
+    if groups_field is not None:
+        filter_columns(groups_field.value.values, maps.chunk_map)
     return ParquetFooter(meta)
 
 

@@ -129,6 +129,11 @@ def read_and_filter(buf: bytes, part_offset: int, part_length: int,
     if lib is None:
         raise RuntimeError("native parquet engine not available (build failed)")
     names, num_children, tags = schema.flatten_depth_first()
+    if ignore_case:
+        # the C ABI expects pre-folded expected names (the reference's Java
+        # caller folds them the same way before crossing JNI); the engine
+        # folds the footer-side names
+        names = [s.lower() for s in names]
     n = len(names)
     names_arr = (ctypes.c_char_p * n)(*[s.encode("utf-8") for s in names])
     nc_arr = (ctypes.c_int32 * n)(*num_children)

@@ -250,8 +250,17 @@ class ColumnPruner {
 
 // -- row-group filtering (NativeParquetJni.cpp:437-519) --------------------
 
+static Value& columns_of(Value& group) {
+  Field* f = group.find(rg::kColumns);
+  if (!f || f->val->elems.empty())
+    throw std::runtime_error("malformed footer: row group without columns");
+  return *f->val;
+}
+
 static int64_t chunk_offset(const Value& chunk) {
   const Field* mdf = chunk.find(cc::kMetaData);
+  if (!mdf)
+    throw std::runtime_error("malformed footer: column chunk without metadata");
   const Value& md = *mdf->val;
   int64_t off = md.get_i(cmd::kDataPageOffset, 0);
   if (md.has(cmd::kDictionaryPageOffset)) {
@@ -273,11 +282,10 @@ static std::vector<Value> filter_groups(Value& meta, int64_t part_offset,
   Field* gf = meta.find(fmd::kRowGroups);
   if (!gf || gf->val->elems.empty()) return out;
   auto& groups = gf->val->elems;
-  bool first_has_md =
-      groups[0].find(rg::kColumns)->val->elems[0].has(cc::kMetaData);
+  bool first_has_md = columns_of(groups[0]).elems[0].has(cc::kMetaData);
   int64_t pre_start = 0, pre_size = 0;
   for (auto& group : groups) {
-    auto& cols = group.find(rg::kColumns)->val->elems;
+    auto& cols = columns_of(group).elems;
     int64_t start;
     if (first_has_md) {
       start = chunk_offset(cols[0]);
@@ -293,8 +301,13 @@ static std::vector<Value> filter_groups(Value& meta, int64_t part_offset,
       total = group.get_i(rg::kTotalCompressedSize, 0);
     } else {
       total = 0;
-      for (auto& c : cols)
-        total += c.find(cc::kMetaData)->val->get_i(cmd::kTotalCompressedSize, 0);
+      for (auto& c : cols) {
+        const Field* mdf = c.find(cc::kMetaData);
+        if (!mdf)
+          throw std::runtime_error(
+              "malformed footer: column chunk without metadata");
+        total += mdf->val->get_i(cmd::kTotalCompressedSize, 0);
+      }
     }
     int64_t mid = start + total / 2;
     if (mid >= part_offset && mid < part_offset + part_length)
@@ -306,7 +319,7 @@ static std::vector<Value> filter_groups(Value& meta, int64_t part_offset,
 static void filter_columns(std::vector<Value>& groups,
                            const std::vector<int>& chunk_map) {
   for (auto& group : groups) {
-    auto& cols = group.find(rg::kColumns)->val->elems;
+    auto& cols = columns_of(group).elems;
     std::vector<Value> kept;
     kept.reserve(chunk_map.size());
     for (int idx : chunk_map) kept.push_back(std::move(cols.at(idx)));

@@ -85,8 +85,17 @@ void CompactReader::read_value(uint8_t type, Value& out) {
       if (size > kMaxContainerSize)
         throw ThriftError("container size exceeds limit");
       out.elems.resize(size);
-      for (uint64_t i = 0; i < size; ++i)
-        read_value(out.elem_type, out.elems[i]);
+      if (out.elem_type == T_BOOL_TRUE || out.elem_type == T_BOOL_FALSE) {
+        // in lists each bool is one byte (1=true, 2=false), unlike struct
+        // fields where the value rides in the field header
+        for (uint64_t i = 0; i < size; ++i) {
+          out.elems[i].type = out.elem_type;
+          out.elems[i].i = (byte() == 1) ? 1 : 0;
+        }
+      } else {
+        for (uint64_t i = 0; i < size; ++i)
+          read_value(out.elem_type, out.elems[i]);
+      }
       break;
     }
     case T_MAP: {

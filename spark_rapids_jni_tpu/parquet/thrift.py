@@ -186,7 +186,11 @@ class CompactReader:
             size = self.read_varint()
         if size > MAX_CONTAINER_SIZE:
             raise ThriftError(f"container size {size} exceeds limit")
-        # in lists, bools are full bytes of compact type 1/2
+        if elem_type in (TType.BOOL_TRUE, TType.BOOL_FALSE):
+            # in lists, each bool is one byte (1=true, 2=false) — unlike in
+            # structs where the value lives in the field header
+            return ListValue(elem_type,
+                             [self._byte() == 1 for _ in range(size)])
         return ListValue(elem_type,
                          [self.read_value(elem_type) for _ in range(size)])
 

@@ -1,0 +1,208 @@
+"""Columnar op library tests, differential against pandas (independent oracle)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+import jax.numpy as jnp
+
+import spark_rapids_jni_tpu as sr
+from spark_rapids_jni_tpu import Column, Table
+from spark_rapids_jni_tpu import ops
+
+RNG = np.random.default_rng(5)
+
+
+def int_col(vals, validity=None, dt=None):
+    return Column.from_numpy(np.asarray(vals), dt, validity)
+
+
+# ---- cast -----------------------------------------------------------------
+
+def test_cast_numeric_widening_and_narrowing():
+    c = int_col(np.asarray([1, -2, 300], dtype=np.int32))
+    assert ops.cast(c, sr.int64).to_pylist() == [1, -2, 300]
+    assert ops.cast(c, sr.float32).data.dtype == np.float32
+    assert ops.cast(c, sr.int8).to_pylist() == [1, -2, 44]  # 300 wraps
+
+
+def test_cast_bool():
+    c = int_col(np.asarray([0, 3, -1], dtype=np.int32))
+    assert ops.cast(c, sr.bool8).to_pylist() == [False, True, True]
+    b = ops.cast(c, sr.bool8)
+    assert ops.cast(b, sr.int64).to_pylist() == [0, 1, 1]
+
+
+def test_cast_decimal_rescale_round_half_away():
+    # decimal(-2) value 12.345 stored as 1234.5? no: unscaled*10^-2
+    c = Column.from_numpy(np.asarray([1234, -1234, 1250, -1250, 1249],
+                                     dtype=np.int64), sr.decimal64(-3))
+    # rescale -3 → -2: divide by 10, round half away from zero
+    out = ops.cast(c, sr.decimal64(-2))
+    assert out.to_pylist() == [123, -123, 125, -125, 125]
+
+
+def test_cast_decimal_to_float_and_back():
+    c = Column.from_numpy(np.asarray([12345, -500], dtype=np.int64),
+                          sr.decimal64(-2))
+    f = ops.cast(c, sr.float64)
+    np.testing.assert_allclose(f.to_numpy(), [123.45, -5.0])
+    back = ops.cast(f, sr.decimal64(-2))
+    assert back.to_pylist() == [12345, -500]
+
+
+# ---- filter ---------------------------------------------------------------
+
+def test_apply_boolean_mask_fixed_and_string():
+    t = Table.from_pydict({"a": [1, 2, 3, 4], "s": ["w", "x", "y", "z"]})
+    out = ops.apply_boolean_mask(t, jnp.asarray([True, False, True, False]))
+    assert out[0].to_pylist() == [1, 3]
+    assert out[1].to_pylist() == ["w", "y"]
+
+
+def test_mask_table_matches_compacting_filter_for_aggs():
+    vals = RNG.integers(0, 100, 1000, dtype=np.int64)
+    mask = RNG.random(1000) < 0.5
+    t = Table([int_col(vals)])
+    compacted = ops.apply_boolean_mask(t, jnp.asarray(mask))
+    masked = ops.mask_table(t, jnp.asarray(mask))
+    assert int(ops.sum_(compacted[0])) == int(ops.sum_(masked[0]))
+    assert int(ops.valid_count(masked[0])) == mask.sum()
+
+
+# ---- reductions -----------------------------------------------------------
+
+def test_reductions_null_aware():
+    c = int_col(np.asarray([5, 100, -7, 3], dtype=np.int64),
+                validity=np.asarray([True, False, True, True]))
+    assert int(ops.sum_(c)) == 1
+    assert int(ops.min_(c)) == -7
+    assert int(ops.max_(c)) == 5
+    assert int(ops.valid_count(c)) == 3
+    np.testing.assert_allclose(float(ops.mean(c)), 1 / 3)
+
+
+# ---- sort -----------------------------------------------------------------
+
+def test_sort_multi_key_vs_pandas():
+    n = 500
+    a = RNG.integers(0, 10, n, dtype=np.int64)
+    b = RNG.standard_normal(n).astype(np.float32)
+    t = Table([int_col(a), Column.from_numpy(b)])
+    out = ops.sort_table(t, keys=[0, 1])
+    df = pd.DataFrame({"a": a, "b": b}).sort_values(["a", "b"],
+                                                    kind="stable")
+    np.testing.assert_array_equal(out[0].to_numpy(), df["a"].to_numpy())
+    np.testing.assert_array_equal(out[1].to_numpy(), df["b"].to_numpy())
+
+
+def test_sort_descending_and_nulls():
+    c = int_col(np.asarray([3, 1, 2, 9], dtype=np.int64),
+                validity=np.asarray([True, True, True, False]))
+    out = ops.sort_table(Table([c]), keys=[0], ascending=[False],
+                         nulls_first=[False])
+    assert out[0].to_pylist() == [3, 2, 1, None]
+    out = ops.sort_table(Table([c]), keys=[0], ascending=[True],
+                         nulls_first=[True])
+    assert out[0].to_pylist() == [None, 1, 2, 3]
+
+
+# ---- groupby --------------------------------------------------------------
+
+def test_groupby_vs_pandas():
+    n = 2000
+    k = RNG.integers(0, 37, n, dtype=np.int64)
+    v = RNG.integers(-50, 50, n, dtype=np.int64)
+    f = RNG.standard_normal(n).astype(np.float64)
+    t = Table([int_col(k), int_col(v), Column.from_numpy(f)])
+    out = ops.groupby_aggregate(t, [0], [(1, "sum"), (1, "count"),
+                                         (1, "min"), (1, "max"), (2, "mean")])
+    df = pd.DataFrame({"k": k, "v": v, "f": f}).groupby("k").agg(
+        s=("v", "sum"), c=("v", "count"), mn=("v", "min"), mx=("v", "max"),
+        fm=("f", "mean")).reset_index().sort_values("k")
+    np.testing.assert_array_equal(out[0].to_numpy(), df["k"].to_numpy())
+    np.testing.assert_array_equal(out[1].to_numpy(), df["s"].to_numpy())
+    np.testing.assert_array_equal(out[2].to_numpy(), df["c"].to_numpy())
+    np.testing.assert_array_equal(out[3].to_numpy(), df["mn"].to_numpy())
+    np.testing.assert_array_equal(out[4].to_numpy(), df["mx"].to_numpy())
+    np.testing.assert_allclose(out[5].to_numpy(), df["fm"].to_numpy())
+
+
+def test_groupby_multi_key_and_nulls():
+    k1 = np.asarray([1, 1, 2, 2, 1], dtype=np.int64)
+    k2 = np.asarray([0, 0, 0, 1, 0], dtype=np.int32)
+    v = np.asarray([10, 20, 30, 40, 99], dtype=np.int64)
+    vv = np.asarray([True, True, True, True, False])
+    t = Table([int_col(k1), int_col(k2), int_col(v, validity=vv)])
+    out = ops.groupby_aggregate(t, [0, 1], [(2, "sum"), (2, "count")])
+    # groups: (1,0)->sum 30 count 2 (null 99 skipped), (2,0)->30, (2,1)->40
+    assert out[0].to_pylist() == [1, 2, 2]
+    assert out[1].to_pylist() == [0, 0, 1]
+    assert out[2].to_pylist() == [30, 30, 40]
+    assert out[3].to_pylist() == [2, 1, 1]
+
+
+def test_groupby_min_of_all_null_group_is_null():
+    k = np.asarray([1, 1, 2], dtype=np.int64)
+    v = np.asarray([7, 8, 9], dtype=np.int64)
+    valid = np.asarray([False, False, True])
+    t = Table([int_col(k), int_col(v, validity=valid)])
+    out = ops.groupby_aggregate(t, [0], [(1, "min")])
+    assert out[1].to_pylist() == [None, 9]
+
+
+# ---- joins ----------------------------------------------------------------
+
+def test_inner_join_vs_pandas():
+    nl, nr = 300, 200
+    lk = RNG.integers(0, 50, nl, dtype=np.int64)
+    rk = RNG.integers(0, 50, nr, dtype=np.int64)
+    lv = np.arange(nl, dtype=np.int32)
+    rv = np.arange(nr, dtype=np.int32) + 1000
+    lt = Table([int_col(lk), int_col(lv)])
+    rt = Table([int_col(rk), int_col(rv)])
+    out = ops.inner_join(lt, rt, 0, 0)
+    got = sorted(zip(out[0].to_pylist(), out[1].to_pylist(),
+                     out[3].to_pylist()))
+    df = pd.merge(pd.DataFrame({"k": lk, "lv": lv}),
+                  pd.DataFrame({"k": rk, "rv": rv}), on="k")
+    expect = sorted(zip(df["k"], df["lv"], df["rv"]))
+    assert got == expect
+
+
+def test_left_join_nulls_unmatched():
+    lt = Table([int_col(np.asarray([1, 2, 3], dtype=np.int64)),
+                int_col(np.asarray([10, 20, 30], dtype=np.int32))])
+    rt = Table([int_col(np.asarray([2, 2], dtype=np.int64)),
+                int_col(np.asarray([7, 8], dtype=np.int32))])
+    out = ops.left_join(lt, rt, 0, 0)
+    rows = sorted(zip(out[0].to_pylist(), out[3].to_pylist(),
+                      key := [0] * out.num_rows))
+    ks = out[0].to_pylist()
+    rvs = out[3].to_pylist()
+    assert sorted(zip(ks, [r if r is not None else -1 for r in rvs])) == \
+        [(1, -1), (2, 7), (2, 8), (3, -1)]
+
+
+def test_semi_anti_join():
+    lt = Table([int_col(np.asarray([1, 2, 3, 4], dtype=np.int64))])
+    rt = Table([int_col(np.asarray([2, 4, 4], dtype=np.int64))])
+    assert ops.semi_join(lt, rt, 0, 0)[0].to_pylist() == [2, 4]
+    assert ops.anti_join(lt, rt, 0, 0)[0].to_pylist() == [1, 3]
+
+
+def test_join_null_keys_never_match():
+    lt = Table([int_col(np.asarray([1, 2], dtype=np.int64),
+                        validity=np.asarray([True, False]))])
+    rt = Table([int_col(np.asarray([2, 1], dtype=np.int64),
+                        validity=np.asarray([False, True]))])
+    out = ops.inner_join(lt, rt, 0, 0)
+    assert out[0].to_pylist() == [1]
+
+
+def test_join_empty_right():
+    lt = Table([int_col(np.asarray([1, 2], dtype=np.int64))])
+    rt = Table([int_col(np.zeros(0, dtype=np.int64))])
+    assert ops.inner_join(lt, rt, 0, 0).num_rows == 0
+    out = ops.left_join(lt, rt, 0, 0)
+    assert out[0].to_pylist() == [1, 2]
+    assert out[1].to_pylist() == [None, None]

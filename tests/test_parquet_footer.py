@@ -182,3 +182,41 @@ def test_serialized_framing():
     assert blob[:4] == b"PAR1" and blob[-4:] == b"PAR1"
     (length,) = struct.unpack("<I", blob[-8:-4])
     assert length == len(blob) - 12
+
+
+def test_uppercase_expected_names_fold_both_sides():
+    # the expected-schema side must fold too (reference folds both sides)
+    raw = extract_footer_bytes(simple_file())
+    schema = StructElement("root", ValueElement("A"), ValueElement("D"))
+    f = read_and_filter(raw, 0, -1, schema, ignore_case=True)
+    assert f.num_columns == 2
+    assert reparse(f).schema.names == ["a", "d"]
+
+
+def test_bool_list_roundtrip_in_generic_tree():
+    # compact encoding: struct { 1: list<bool> [T,F,T]; 2: i32 5 }
+    blob = bytes([0x19, 0x31, 0x01, 0x02, 0x01, 0x15, 0x0A, 0x00])
+    s = T.parse_struct(blob)
+    lv = s.get(1)
+    assert list(lv.values) == [True, False, True]
+    assert s.get(2) == 5
+    assert T.serialize_struct(s) == blob
+
+
+def test_malformed_footer_clean_errors():
+    from spark_rapids_jni_tpu.parquet.thrift import (Struct, Field, ListValue,
+                                                     TType, serialize_struct)
+    # struct with no schema field at all
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="no schema"):
+        read_and_filter(serialize_struct(Struct([])), 0, -1,
+                        StructElement("root", ValueElement("a")))
+    # schema present but no row_groups: prunes fine, zero rows
+    root = Struct([Field(4, TType.BINARY, b"root"),
+                   Field(5, TType.I32, 1)])
+    leaf = Struct([Field(1, TType.I32, 1),    # type = INT32 (leaf)
+                   Field(4, TType.BINARY, b"a")])
+    meta = Struct([Field(2, TType.LIST, ListValue(TType.STRUCT, [root, leaf]))])
+    f = read_and_filter(serialize_struct(meta), 0, 10, 
+                        StructElement("root", ValueElement("a")))
+    assert f.num_rows == 0 and f.num_columns == 1

@@ -82,3 +82,27 @@ def test_native_use_after_close_raises():
     nat.close()
     with pytest.raises(ValueError):
         _ = nat.num_rows
+
+
+def test_native_uppercase_expected_names_fold():
+    raw = extract_footer_bytes(simple_file())
+    schema = StructElement("root", ValueElement("A"), ValueElement("D"))
+    py = read_and_filter(raw, 0, -1, schema, ignore_case=True)
+    with footer_native.read_and_filter(raw, 0, -1, schema, True) as nat:
+        assert nat.num_columns == 2
+        assert nat.serialize_thrift_file() == py.serialize_thrift_file()
+
+
+def test_native_malformed_rowgroup_error_not_crash():
+    from spark_rapids_jni_tpu.parquet.thrift import (Struct, Field, ListValue,
+                                                     TType, serialize_struct)
+    root = Struct([Field(4, TType.BINARY, b"root"), Field(5, TType.I32, 1)])
+    leaf = Struct([Field(1, TType.I32, 1), Field(4, TType.BINARY, b"a")])
+    bad_group = Struct([Field(3, TType.I64, 7)])   # num_rows but NO columns
+    meta = Struct([
+        Field(2, TType.LIST, ListValue(TType.STRUCT, [root, leaf])),
+        Field(4, TType.LIST, ListValue(TType.STRUCT, [bad_group]))])
+    blob = serialize_struct(meta)
+    schema = StructElement("root", ValueElement("a"))
+    with pytest.raises(ValueError, match="malformed footer"):
+        footer_native.read_and_filter(blob, 0, 100, schema)
