@@ -1,0 +1,2 @@
+from .injector import (FaultInjector, fault_site, get_injector,  # noqa: F401
+                       enable, disable)

@@ -1,0 +1,204 @@
+"""Fault injection shim — resilience-testing tool (libcufaultinj parity).
+
+The reference ships ``libcufaultinj.so``: a CUPTI interceptor loaded via
+``CUDA_INJECTION64_PATH`` that matches CUDA API callbacks against a JSON
+config and injects faults so the framework above can prove its retry/
+quarantine logic (``faultinj/faultinj.cu``, ``faultinj/README.md:3-16``;
+SURVEY §2.6, §3.4).  TPU translation: there is no CUPTI; the interception
+point is this framework's own dispatch layer plus the patchable JAX host APIs
+(device_put / jit-compile).  Parity preserved feature-for-feature:
+
+* config matched by site name or ``"*"`` (``faultinj.cu:142-152``)
+* per-rule ``percent`` dice and decrementing ``interceptionCount`` budget
+  under a lock (``faultinj.cu:247-315``)
+* injection types: raise (the CUDA trap/assert analogs become exception
+  classes) or substituted return value (``faultinj.cu:317-340``)
+* hot reload of the JSON config — a watcher thread picks up edits without
+  restarting, mtime-polling standing in for inotify (``faultinj.cu:419-470``)
+* seeded RNG for reproducible schedules (``faultinj.cu:96-100``)
+
+Config schema (mirrors ``faultinj/README.md:104-141``)::
+
+    {
+      "logLevel": "info",
+      "dynamic": true,                  # hot reload on/off
+      "seed": 42,
+      "sites": {
+        "convert_to_rows": {
+          "percent": 50,                # dice per interception
+          "interceptionCount": 10,      # budget; -1 = unlimited
+          "injectionType": "device_error"   # or "oom", "substitute"
+          "substituteResult": null          # for injectionType substitute
+        },
+        "*": { ... }                    # wildcard, lowest precedence
+      }
+    }
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import random
+import threading
+import time
+from typing import Any, Callable, Optional
+
+ENV_CONFIG_PATH = "FAULT_INJECTOR_CONFIG_PATH"   # same env var as faultinj.cu:93
+
+
+class InjectedDeviceError(RuntimeError):
+    """Analog of the injected PTX trap: the device is gone (fatal)."""
+
+
+class InjectedOomError(MemoryError):
+    """Injected allocation failure (RMM OOM analog)."""
+
+
+_INJECTION_TYPES = ("device_error", "oom", "substitute")
+
+
+class _Rule:
+    def __init__(self, spec: dict):
+        self.percent = float(spec.get("percent", 100.0))
+        self.count = int(spec.get("interceptionCount", -1))
+        self.injection_type = spec.get("injectionType", "device_error")
+        if self.injection_type not in _INJECTION_TYPES:
+            raise ValueError(f"unknown injectionType {self.injection_type!r}")
+        self.substitute = spec.get("substituteResult")
+
+
+class FaultInjector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: dict[str, _Rule] = {}
+        self._rng = random.Random()
+        self._enabled = False
+        self._config_path: Optional[str] = None
+        self._watcher: Optional[threading.Thread] = None
+        self._watcher_stop = threading.Event()
+        self._mtime = 0.0
+        self.injected_count = 0   # observability: how many faults fired
+
+    # -- config -------------------------------------------------------------
+    def load_config(self, path: str) -> None:
+        with open(path) as f:
+            cfg = json.load(f)
+        rules = {name: _Rule(spec)
+                 for name, spec in cfg.get("sites", {}).items()}
+        with self._lock:
+            self._rules = rules
+            self._rng = random.Random(cfg.get("seed"))
+            self._config_path = path
+            self._mtime = os.path.getmtime(path)
+        if cfg.get("dynamic"):
+            if self._watcher is None:
+                self._start_watcher()
+        elif self._watcher is not None:
+            # config edited to dynamic:false → freeze the schedule
+            self._watcher_stop.set()
+            self._watcher = None
+
+    def _start_watcher(self) -> None:
+        # mtime polling in a daemon thread — the portable stand-in for the
+        # reference's inotify watcher (faultinj.cu:419-470)
+        self._watcher_stop.clear()
+
+        def watch():
+            while not self._watcher_stop.wait(0.25):
+                path = self._config_path
+                if not path:
+                    continue
+                try:
+                    m = os.path.getmtime(path)
+                except OSError:
+                    continue
+                if m != self._mtime:
+                    # record the observed mtime first so a bad edit is not
+                    # re-parsed on every poll until the file changes again
+                    self._mtime = m
+                    try:
+                        self.load_config(path)
+                    except (OSError, ValueError, json.JSONDecodeError):
+                        pass   # keep the old config on a bad edit
+
+        self._watcher = threading.Thread(target=watch, daemon=True,
+                                         name="faultinj-watcher")
+        self._watcher.start()
+
+    # -- lifecycle ----------------------------------------------------------
+    def enable(self, config_path: Optional[str] = None) -> None:
+        path = config_path or os.environ.get(ENV_CONFIG_PATH)
+        if path:
+            self.load_config(path)
+        self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+        self._watcher_stop.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=2)
+            self._watcher = None
+        with self._lock:
+            self._rules = {}
+            self.injected_count = 0
+
+    # -- interception -------------------------------------------------------
+    def check(self, site: str):
+        """Called at a fault site.  Returns None (no fault), raises, or
+        returns (True, substitute_value) for a substituted result."""
+        if not self._enabled:
+            return None
+        with self._lock:
+            rule = self._rules.get(site) or self._rules.get("*")
+            if rule is None:
+                return None
+            if rule.count == 0:
+                return None
+            if self._rng.uniform(0, 100) >= rule.percent:
+                return None
+            if rule.count > 0:
+                rule.count -= 1
+            self.injected_count += 1
+            injection_type = rule.injection_type
+            substitute = rule.substitute
+        if injection_type == "device_error":
+            raise InjectedDeviceError(
+                f"[faultinj] injected device error at site {site!r}")
+        if injection_type == "oom":
+            raise InjectedOomError(
+                f"[faultinj] injected allocation failure at site {site!r}")
+        return (True, substitute)
+
+
+_global = FaultInjector()
+
+
+def get_injector() -> FaultInjector:
+    return _global
+
+
+def enable(config_path: Optional[str] = None) -> None:
+    _global.enable(config_path)
+
+
+def disable() -> None:
+    _global.disable()
+
+
+def fault_site(name: str) -> Callable:
+    """Decorator marking a framework dispatch point as an injectable site."""
+
+    def wrap(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def inner(*args: Any, **kwargs: Any):
+            hit = _global.check(name)
+            if hit is not None:
+                return hit[1]
+            return fn(*args, **kwargs)
+
+        inner.__fault_site__ = name
+        return inner
+
+    return wrap

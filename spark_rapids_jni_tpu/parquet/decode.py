@@ -28,6 +28,7 @@ import numpy as np
 
 from .. import types as T
 from ..column import Column, Table
+from ..faultinj import fault_site
 from .footer import FMD, RG, CC, SE, extract_footer_bytes
 from .thrift import CompactReader, Struct
 
@@ -348,6 +349,7 @@ def _leaf_schema_elements(meta: Struct):
     return out
 
 
+@fault_site("parquet_read_table")
 def read_table(file_bytes: bytes,
                columns: Optional[list[str]] = None) -> Table:
     """Read a (flat-schema) parquet file into a device Table."""

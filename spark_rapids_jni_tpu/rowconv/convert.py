@@ -36,6 +36,7 @@ import numpy as np
 
 from .. import types as T
 from ..column import Column, Table
+from ..faultinj import fault_site
 from ..utils import bitmask
 from ..utils.tracing import traced
 from .layout import (RowLayout, compute_row_layout, build_batches,
@@ -281,6 +282,7 @@ def _check_row_size(layout: RowLayout, row_sizes: np.ndarray | None = None):
 
 
 @traced("convert_to_rows")
+@fault_site("convert_to_rows")
 def convert_to_rows(table: Table,
                     max_batch_bytes: Optional[int] = None) -> list[RowBatch]:
     """Table → JCUDF row batches (``convert_to_rows``, row_conversion.cu:1902-1960).
@@ -338,6 +340,7 @@ def _slice_column(col: Column, lo: int, hi: int) -> Column:
 
 
 @traced("convert_from_rows")
+@fault_site("convert_from_rows")
 def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
     """JCUDF rows → Table (``convert_from_rows``, row_conversion.cu:2032-2250).
 

@@ -1,0 +1,125 @@
+"""Fault-injection shim tests (tier-3 resilience tooling, SURVEY §2.6/§4)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import Column, Table, convert_to_rows
+from spark_rapids_jni_tpu import faultinj
+from spark_rapids_jni_tpu.faultinj.injector import (InjectedDeviceError,
+                                                    InjectedOomError,
+                                                    FaultInjector)
+
+
+@pytest.fixture(autouse=True)
+def clean_injector():
+    yield
+    faultinj.disable()
+
+
+def write_cfg(tmp_path, cfg):
+    p = tmp_path / "faultinj.json"
+    p.write_text(json.dumps(cfg))
+    return str(p)
+
+
+def small_table():
+    return Table([Column.from_numpy(np.arange(10, dtype=np.int64))])
+
+
+def test_injects_on_named_site(tmp_path):
+    faultinj.enable(write_cfg(tmp_path, {
+        "sites": {"convert_to_rows": {"percent": 100,
+                                      "injectionType": "device_error"}}}))
+    with pytest.raises(InjectedDeviceError, match="convert_to_rows"):
+        convert_to_rows(small_table())
+
+
+def test_untargeted_site_unaffected(tmp_path):
+    faultinj.enable(write_cfg(tmp_path, {
+        "sites": {"parquet_read_table": {"percent": 100}}}))
+    assert len(convert_to_rows(small_table())) == 1   # unaffected
+
+
+def test_wildcard_matches_everything(tmp_path):
+    faultinj.enable(write_cfg(tmp_path, {
+        "sites": {"*": {"percent": 100, "injectionType": "oom"}}}))
+    with pytest.raises(InjectedOomError):
+        convert_to_rows(small_table())
+
+
+def test_interception_count_budget(tmp_path):
+    faultinj.enable(write_cfg(tmp_path, {
+        "sites": {"convert_to_rows": {"percent": 100,
+                                      "interceptionCount": 2}}}))
+    for _ in range(2):
+        with pytest.raises(InjectedDeviceError):
+            convert_to_rows(small_table())
+    # budget exhausted → calls succeed again
+    assert len(convert_to_rows(small_table())) == 1
+    assert faultinj.get_injector().injected_count == 2
+
+
+def test_percent_dice_seeded(tmp_path):
+    faultinj.enable(write_cfg(tmp_path, {
+        "seed": 7,
+        "sites": {"convert_to_rows": {"percent": 50}}}))
+    outcomes = []
+    for _ in range(40):
+        try:
+            convert_to_rows(small_table())
+            outcomes.append(False)
+        except InjectedDeviceError:
+            outcomes.append(True)
+    hits = sum(outcomes)
+    assert 5 < hits < 35   # ~50% with seeded dice
+
+
+def test_substitute_result(tmp_path):
+    faultinj.enable(write_cfg(tmp_path, {
+        "sites": {"convert_to_rows": {"percent": 100,
+                                      "injectionType": "substitute",
+                                      "substituteResult": []}}}))
+    assert convert_to_rows(small_table()) == []
+
+
+def test_hot_reload(tmp_path):
+    path = write_cfg(tmp_path, {"dynamic": True, "sites": {}})
+    faultinj.enable(path)
+    assert len(convert_to_rows(small_table())) == 1
+    # rewrite config; watcher polls every 250ms
+    time.sleep(0.05)
+    with open(path, "w") as f:
+        json.dump({"dynamic": True,
+                   "sites": {"convert_to_rows": {"percent": 100}}}, f)
+    os.utime(path)
+    deadline = time.time() + 5
+    fired = False
+    while time.time() < deadline:
+        try:
+            convert_to_rows(small_table())
+        except InjectedDeviceError:
+            fired = True
+            break
+        time.sleep(0.1)
+    assert fired, "hot reload did not pick up the new config"
+
+
+def test_env_var_config(tmp_path, monkeypatch):
+    path = write_cfg(tmp_path, {
+        "sites": {"convert_to_rows": {"percent": 100}}})
+    monkeypatch.setenv("FAULT_INJECTOR_CONFIG_PATH", path)
+    faultinj.enable()   # picks the path from the env, like the reference
+    with pytest.raises(InjectedDeviceError):
+        convert_to_rows(small_table())
+
+
+def test_bad_config_rejected(tmp_path):
+    inj = FaultInjector()
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"sites": {"x": {"injectionType": "nope"}}}))
+    with pytest.raises(ValueError, match="injectionType"):
+        inj.load_config(str(p))
