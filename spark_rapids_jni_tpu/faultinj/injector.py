@@ -120,8 +120,10 @@ class FaultInjector:
                     self._mtime = m
                     try:
                         self.load_config(path)
-                    except (OSError, ValueError, json.JSONDecodeError):
-                        pass   # keep the old config on a bad edit
+                    except Exception:
+                        pass   # keep the old config on a bad edit; the
+                        # watcher must survive any parse/coerce error
+                        # (TypeError from e.g. "percent": null included)
 
         self._watcher = threading.Thread(target=watch, daemon=True,
                                          name="faultinj-watcher")

@@ -40,7 +40,8 @@ from ..faultinj import fault_site
 from ..utils import bitmask
 from ..utils.tracing import traced
 from .layout import (RowLayout, compute_row_layout, build_batches,
-                     row_sizes_with_strings, MAX_ROW_SIZE, MAX_BATCH_BYTES)
+                     row_sizes_with_strings, MAX_ROW_SIZE, MAX_BATCH_BYTES,
+                     BATCH_ROW_MULTIPLE)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -145,6 +146,41 @@ def _from_rows_fixed(layout: RowLayout, rows: jnp.ndarray):
                   layout.validity_offset + layout.validity_bytes]
     valid = bitmask.unpack_bool_matrix(vbytes, layout.num_columns)
     return tuple(datas), valid
+
+
+# Fused whole-call cores for the public fixed-width path.  The orchestration
+# around the reference's kernels is host code (offset columns built with
+# Thrust + D2D copies, row_conversion.cu:1460-1539); on a remote-dispatch TPU
+# that host work (and its H2D offset upload) dominates, so the full call —
+# validity-matrix build, byte transpose, offsets arange — is one jit program
+# and the only transfer is the column payloads already resident in HBM.
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _to_rows_fixed_full(layout: RowLayout, has_valid: tuple[bool, ...],
+                        datas: tuple[jnp.ndarray, ...],
+                        valids: tuple[jnp.ndarray, ...]):
+    """Fixed-width table → (flat row bytes, int32 row offsets), one dispatch.
+
+    ``valids`` carries arrays only for columns where ``has_valid`` is True;
+    all-valid columns get their ones generated (and fused away) on device.
+    """
+    n = datas[0].shape[0]
+    vi = iter(valids)
+    cols_valid = [next(vi) if hv else jnp.ones((n,), dtype=jnp.bool_)
+                  for hv in has_valid]
+    valid = jnp.stack(cols_valid, axis=1)
+    rows2d = _to_rows_fixed(layout, datas, valid)
+    offsets = jnp.arange(n + 1, dtype=jnp.int32) * layout.fixed_row_size
+    return rows2d.reshape(-1), offsets
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _from_rows_fixed_full(layout: RowLayout, data: jnp.ndarray):
+    """Flat row bytes → (datas, per-column validity vectors), one dispatch."""
+    rows2d = data.reshape(-1, layout.fixed_row_size)
+    datas, valid = _from_rows_fixed(layout, rows2d)
+    valids = tuple(valid[:, ci] for ci in range(layout.num_columns))
+    return datas, valids
 
 
 # ---------------------------------------------------------------------------
@@ -294,36 +330,61 @@ def convert_to_rows(table: Table,
     n = table.num_rows
 
     if layout.fixed_width_only:
+        # Constant row stride ⇒ batch boundaries are pure arithmetic (the
+        # reference reaches the same boundaries by scanning a constant-valued
+        # row_sizes vector, row_conversion.cu:1460-1539) and offsets are a
+        # device-side arange — no host scan, no H2D offset upload.
         _check_row_size(layout)
-        row_sizes = np.full(n, layout.fixed_row_size, dtype=np.int64)
-    else:
-        total_lens = np.zeros(n, dtype=np.int64)
-        for ci in layout.variable_column_indices:
-            offs = np.asarray(table[ci].offsets, dtype=np.int64)
-            total_lens += offs[1:] - offs[:-1]
-        row_sizes = row_sizes_with_strings(layout, total_lens)
-        _check_row_size(layout, row_sizes)
+        stride = layout.fixed_row_size
+        if stride > max_batch_bytes:
+            raise ValueError("a single row exceeds the maximum batch size")
+        if n * stride <= max_batch_bytes:
+            rows_per_batch = n
+        else:
+            rows_per_batch = max_batch_bytes // stride
+            # round to a 32-row multiple only when more than one multiple
+            # fits — same rule as build_batches (row_conversion.cu:1504-1506)
+            if rows_per_batch > BATCH_ROW_MULTIPLE:
+                rows_per_batch = (rows_per_batch // BATCH_ROW_MULTIPLE
+                                  * BATCH_ROW_MULTIPLE)
+        out = []
+        has_valid = tuple(c.validity is not None for c in table.columns)
+        for lo in range(0, max(n, 1), max(rows_per_batch, 1)):
+            hi = min(lo + rows_per_batch, n)
+            cols = (table.columns if (lo, hi) == (0, n)
+                    else [_slice_column(c, lo, hi) for c in table.columns])
+            data, offsets = _to_rows_fixed_full(
+                layout, has_valid, tuple(_stage(c) for c in cols),
+                tuple(c.validity for c in cols if c.validity is not None))
+            out.append(RowBatch(data, offsets))
+            if n == 0:
+                break
+        return out
+
+    # variable-width (strings) path: row sizes are data-dependent, so the
+    # reference's scan + lower_bound batching applies as-is
+    total_lens = np.zeros(n, dtype=np.int64)
+    for ci in layout.variable_column_indices:
+        offs = np.asarray(table[ci].offsets, dtype=np.int64)
+        total_lens += offs[1:] - offs[:-1]
+    row_sizes = row_sizes_with_strings(layout, total_lens)
+    _check_row_size(layout, row_sizes)
 
     batches = build_batches(row_sizes, max_batch_bytes)
-    out: list[RowBatch] = []
+    out = []
     for bi, (lo, hi) in enumerate(zip(batches.row_boundaries[:-1],
                                       batches.row_boundaries[1:])):
         sub = Table([_slice_column(c, lo, hi) for c in table.columns])
         valid = _table_valid_matrix(sub)
-        if layout.fixed_width_only:
-            rows2d = _to_rows_fixed(layout, tuple(_stage(c) for c in sub.columns),
-                                    valid)
-            data = rows2d.reshape(-1)
-        else:
-            row_offs = jnp.asarray(
-                batches.row_offsets_within_batch[bi].astype(np.int64))
-            data = _to_rows_var(
-                layout, batches.batch_bytes[bi],
-                tuple(_stage(c) for c in sub.columns),
-                # _slice_column already rebases string offsets to zero
-                tuple(sub[ci].offsets
-                      for ci in layout.variable_column_indices),
-                valid, row_offs)
+        row_offs = jnp.asarray(
+            batches.row_offsets_within_batch[bi].astype(np.int64))
+        data = _to_rows_var(
+            layout, batches.batch_bytes[bi],
+            tuple(_stage(c) for c in sub.columns),
+            # _slice_column already rebases string offsets to zero
+            tuple(sub[ci].offsets
+                  for ci in layout.variable_column_indices),
+            valid, row_offs)
         out.append(RowBatch(
             data, jnp.asarray(batches.row_offsets_within_batch[bi])))
     return out
@@ -352,9 +413,10 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
     row_offsets = batch.offsets.astype(jnp.int64)
 
     if layout.fixed_width_only:
-        rows2d = batch.data.reshape(n, layout.fixed_row_size)
-        datas, valid = _from_rows_fixed(layout, rows2d)
-        return _assemble(schema, datas, valid, None, None)
+        datas, valids = _from_rows_fixed_full(layout, batch.data)
+        cols = [Column(dt, _unstage(datas[ci], dt.storage), validity=valids[ci])
+                for ci, dt in enumerate(schema)]
+        return Table(cols)
 
     # strings: phase 1 — lengths; host sync for char totals (reference syncs
     # identically at row_conversion.cu:2215)
