@@ -1,0 +1,95 @@
+"""Loader for the single native artifact ``libsrjt.so``.
+
+All C++ components (Parquet footer engine, host JCUDF transcode engine) are
+compiled into one shared library, preserving the reference's packaging
+invariant of a single JVM-loadable artifact (``CMakeLists.txt:199-208``).
+Built lazily with ``make`` on first use; callers degrade gracefully when no
+toolchain is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_NATIVE_DIR = os.path.dirname(__file__)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libsrjt.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_c = ctypes
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR, "-s"], check=True,
+                       capture_output=True, timeout=300)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def _sig(lib, name, restype, argtypes):
+    fn = getattr(lib, name)
+    fn.restype = restype
+    fn.argtypes = argtypes
+    return fn
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    i32, i64, u64 = _c.c_int32, _c.c_int64, _c.c_uint64
+    p_i32 = _c.POINTER(i32)
+    p_i64 = _c.POINTER(i64)
+    p_u8 = _c.POINTER(_c.c_uint8)
+    pp = _c.POINTER(_c.c_void_p)   # generic pointer-array
+
+    # footer engine (parquet/native/footer_engine.cpp)
+    _sig(lib, "srjt_footer_read_and_filter", _c.c_void_p,
+         [_c.c_char_p, u64, i64, i64, _c.POINTER(_c.c_char_p), p_i32, p_i32,
+          i32, i32, i32, _c.c_char_p, u64])
+    _sig(lib, "srjt_footer_num_rows", i64, [_c.c_void_p])
+    _sig(lib, "srjt_footer_num_columns", i64, [_c.c_void_p])
+    _sig(lib, "srjt_footer_serialize", i64,
+         [_c.c_void_p, _c.c_char_p, u64, _c.c_char_p, u64])
+    _sig(lib, "srjt_footer_free", None, [_c.c_void_p])
+
+    # rowconv engine (native/rowconv_engine.cpp)
+    _sig(lib, "srjt_layout", i32,
+         [p_i32, p_i32, i32, p_i32, p_i32, p_i32, p_i32])
+    _sig(lib, "srjt_pack_fixed", None,
+         [pp, pp, p_i32, p_i32, i32, i64, i32, i32, p_u8])
+    _sig(lib, "srjt_unpack_fixed", None,
+         [p_u8, i64, i32, p_i32, p_i32, i32, i32, pp, pp])
+    _sig(lib, "srjt_var_row_offsets", i64, [pp, i32, i64, i32, p_i64])
+    _sig(lib, "srjt_pack_var", None,
+         [pp, pp, pp, p_i32, p_i32, p_u8, i32, i64, p_i64, i32, i32, p_u8])
+    _sig(lib, "srjt_unpack_var", None,
+         [p_u8, p_i64, i64, p_i32, p_i32, p_u8, i32, i32, pp, pp, pp])
+    _sig(lib, "srjt_gather_chars", None,
+         [p_u8, p_i64, i64, i32, p_i32, p_u8])
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) libsrjt.so; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        _bind(lib)
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None

@@ -1,0 +1,4 @@
+// Intentionally empty: libsrjt_parquet.so is a stub that links libsrjt.so,
+// kept so earlier loaders of the footer-only soname keep working — the same
+// trick the reference plays with libcudfjni.so (CMakeLists.txt:203-208,
+// src/emptyfile.cpp).

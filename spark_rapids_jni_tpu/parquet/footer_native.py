@@ -10,64 +10,13 @@ num_columns / serialize / free operate on the handle.
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
-import threading
-from typing import Optional
 
+from .. import native as native_lib
 from .footer import SchemaNode
 
-_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "libsrjt_parquet.so")
-_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_tried = False
-
-
-def _build() -> bool:
-    try:
-        subprocess.run(["make", "-C", _NATIVE_DIR, "-s"], check=True,
-                       capture_output=True, timeout=120)
-        return True
-    except (subprocess.SubprocessError, FileNotFoundError):
-        return False
-
-
-def load() -> Optional[ctypes.CDLL]:
-    """Load (building if needed) the native library; None if unavailable."""
-    global _lib, _tried
-    with _lock:
-        if _lib is not None or _tried:
-            return _lib
-        _tried = True
-        if not os.path.exists(_LIB_PATH) and not _build():
-            return None
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
-            return None
-        lib.srjt_footer_read_and_filter.restype = ctypes.c_void_p
-        lib.srjt_footer_read_and_filter.argtypes = [
-            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int64, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_int32, ctypes.c_char_p, ctypes.c_uint64]
-        lib.srjt_footer_num_rows.restype = ctypes.c_int64
-        lib.srjt_footer_num_rows.argtypes = [ctypes.c_void_p]
-        lib.srjt_footer_num_columns.restype = ctypes.c_int64
-        lib.srjt_footer_num_columns.argtypes = [ctypes.c_void_p]
-        lib.srjt_footer_serialize.restype = ctypes.c_int64
-        lib.srjt_footer_serialize.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
-            ctypes.c_char_p, ctypes.c_uint64]
-        lib.srjt_footer_free.restype = None
-        lib.srjt_footer_free.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
-
-
-def available() -> bool:
-    return load() is not None
+# symbol signatures are bound centrally by the unified artifact loader
+load = native_lib.load
+available = native_lib.available
 
 
 class NativeParquetFooter:

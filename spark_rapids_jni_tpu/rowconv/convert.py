@@ -338,27 +338,26 @@ def convert_to_rows(table: Table,
         stride = layout.fixed_row_size
         if stride > max_batch_bytes:
             raise ValueError("a single row exceeds the maximum batch size")
-        if n * stride <= max_batch_bytes:
-            rows_per_batch = n
-        else:
-            rows_per_batch = max_batch_bytes // stride
-            # round to a 32-row multiple only when more than one multiple
-            # fits — same rule as build_batches (row_conversion.cu:1504-1506)
-            if rows_per_batch > BATCH_ROW_MULTIPLE:
-                rows_per_batch = (rows_per_batch // BATCH_ROW_MULTIPLE
-                                  * BATCH_ROW_MULTIPLE)
+        # Reference boundary rule (build_batches, row_conversion.cu:1460-1539,
+        # mirrored by layout.build_batches): split while the remainder
+        # overflows the cap, rounding each split to a 32-row multiple only
+        # when more than one multiple fits; the final batch is never rounded.
+        boundaries = [0]
+        while (n - boundaries[-1]) * stride > max_batch_bytes:
+            k = max_batch_bytes // stride
+            if k > BATCH_ROW_MULTIPLE:
+                k = k // BATCH_ROW_MULTIPLE * BATCH_ROW_MULTIPLE
+            boundaries.append(boundaries[-1] + k)
+        boundaries.append(n)
         out = []
         has_valid = tuple(c.validity is not None for c in table.columns)
-        for lo in range(0, max(n, 1), max(rows_per_batch, 1)):
-            hi = min(lo + rows_per_batch, n)
+        for lo, hi in zip(boundaries[:-1], boundaries[1:]):
             cols = (table.columns if (lo, hi) == (0, n)
                     else [_slice_column(c, lo, hi) for c in table.columns])
             data, offsets = _to_rows_fixed_full(
                 layout, has_valid, tuple(_stage(c) for c in cols),
                 tuple(c.validity for c in cols if c.validity is not None))
             out.append(RowBatch(data, offsets))
-            if n == 0:
-                break
         return out
 
     # variable-width (strings) path: row sizes are data-dependent, so the
@@ -410,13 +409,18 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
     schema = list(schema)
     layout = compute_row_layout(schema)
     n = batch.num_rows
-    row_offsets = batch.offsets.astype(jnp.int64)
 
     if layout.fixed_width_only:
+        if batch.data.shape[0] != n * layout.fixed_row_size:
+            raise ValueError(
+                f"row data holds {batch.data.shape[0]} bytes but offsets "
+                f"describe {n} rows of {layout.fixed_row_size} bytes")
         datas, valids = _from_rows_fixed_full(layout, batch.data)
         cols = [Column(dt, _unstage(datas[ci], dt.storage), validity=valids[ci])
                 for ci, dt in enumerate(schema)]
         return Table(cols)
+
+    row_offsets = batch.offsets.astype(jnp.int64)
 
     # strings: phase 1 — lengths; host sync for char totals (reference syncs
     # identically at row_conversion.cu:2215)

@@ -1,0 +1,190 @@
+"""ctypes binding to the native (C++) host JCUDF transcode engine.
+
+Exposes the same ``to_rows_np`` / ``from_rows_np`` surface as the NumPy
+oracle (``reference.py``) but backed by ``native/rowconv_engine.cpp`` — the
+host-runtime analog of the reference's C++ orchestration layer
+(``row_conversion.cu:1718-1890``), and an *independent* second oracle for the
+device path (the reference differentially tests two engines against each
+other, ``tests/row_conversion.cpp:49-58``; here the pair is C++ vs XLA).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .. import native as native_lib
+from .. import types as T
+from ..column import Column, Table
+from .layout import compute_row_layout
+
+
+def available() -> bool:
+    return native_lib.available()
+
+
+def _require():
+    lib = native_lib.load()
+    if lib is None:
+        raise RuntimeError("native rowconv engine not available (build failed)")
+    return lib
+
+
+def _ptr_array(arrays: list[np.ndarray | None]):
+    """C array of void* from numpy arrays (None → nullptr)."""
+    out = (ctypes.c_void_p * len(arrays))()
+    for i, a in enumerate(arrays):
+        out[i] = None if a is None else a.ctypes.data_as(ctypes.c_void_p).value
+    return out
+
+
+def _i32(arr) -> np.ndarray:
+    return np.ascontiguousarray(arr, dtype=np.int32)
+
+
+def layout_native(schema: list[T.DType]):
+    """Row layout computed by the C++ engine (differential check vs layout.py)."""
+    lib = _require()
+    sizes = _i32([dt.itemsize for dt in schema])
+    aligns = _i32([dt.row_alignment for dt in schema])
+    n = len(schema)
+    starts = np.zeros(n, dtype=np.int32)
+    vo = ctypes.c_int32()
+    fpv = ctypes.c_int32()
+    rs = ctypes.c_int32()
+    rc = lib.srjt_layout(
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        aligns.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n,
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.byref(vo), ctypes.byref(fpv), ctypes.byref(rs))
+    if rc != 0:
+        raise ValueError("srjt_layout rejected schema")
+    return tuple(starts.tolist()), int(vo.value), int(fpv.value), int(rs.value)
+
+
+def _host_cols(table: Table):
+    """(data bytes, validity bytes-or-None, offsets-or-None) per column."""
+    datas, valids, offs = [], [], []
+    for col in table.columns:
+        if col.dtype.is_variable_width:
+            datas.append(np.ascontiguousarray(np.asarray(col.data),
+                                              dtype=np.uint8))
+            offs.append(_i32(np.asarray(col.offsets)))
+        else:
+            datas.append(np.ascontiguousarray(
+                np.asarray(col.data), dtype=col.dtype.storage).view(np.uint8))
+            offs.append(None)
+        valids.append(None if col.validity is None else
+                      np.ascontiguousarray(np.asarray(col.validity),
+                                           dtype=np.uint8))
+    return datas, valids, offs
+
+
+def to_rows_np(table: Table) -> tuple[np.ndarray, np.ndarray]:
+    """Table → (row_bytes uint8 [total], row_offsets int32 [n+1]) via C++."""
+    lib = _require()
+    layout = compute_row_layout(table.schema)
+    n = table.num_rows
+    starts = _i32(layout.column_starts)
+    sizes = _i32(layout.column_sizes)
+    datas, valids, offs = _host_cols(table)
+    p_i32 = ctypes.POINTER(ctypes.c_int32)
+    p_i64 = ctypes.POINTER(ctypes.c_int64)
+    p_u8 = ctypes.POINTER(ctypes.c_uint8)
+
+    if layout.fixed_width_only:
+        out = np.empty(n * layout.fixed_row_size, dtype=np.uint8)
+        lib.srjt_pack_fixed(
+            _ptr_array(datas), _ptr_array(valids),
+            starts.ctypes.data_as(p_i32), sizes.ctypes.data_as(p_i32),
+            table.num_columns, n, layout.fixed_row_size,
+            layout.validity_offset, out.ctypes.data_as(p_u8))
+        row_offsets = (np.arange(n + 1, dtype=np.int64)
+                       * layout.fixed_row_size)
+        return out, row_offsets.astype(np.int32)
+
+    var_offs = [offs[ci] for ci in layout.variable_column_indices]
+    row_offsets = np.zeros(n + 1, dtype=np.int64)
+    total = lib.srjt_var_row_offsets(
+        _ptr_array(var_offs), len(var_offs), n, layout.fixed_plus_validity,
+        row_offsets.ctypes.data_as(p_i64))
+    is_var = np.asarray([dt.is_variable_width for dt in table.schema],
+                        dtype=np.uint8)
+    out = np.empty(int(total), dtype=np.uint8)
+    lib.srjt_pack_var(
+        _ptr_array(datas), _ptr_array(var_offs), _ptr_array(valids),
+        starts.ctypes.data_as(p_i32), sizes.ctypes.data_as(p_i32),
+        is_var.ctypes.data_as(p_u8), table.num_columns, n,
+        row_offsets.ctypes.data_as(p_i64), layout.validity_offset,
+        layout.fixed_plus_validity, out.ctypes.data_as(p_u8))
+    return out, row_offsets.astype(np.int32)
+
+
+def from_rows_np(row_bytes: np.ndarray, row_offsets: np.ndarray,
+                 schema: list[T.DType]) -> Table:
+    """(row_bytes, row_offsets) + schema → Table via the C++ engine."""
+    lib = _require()
+    schema = list(schema)
+    layout = compute_row_layout(schema)
+    row_bytes = np.ascontiguousarray(row_bytes, dtype=np.uint8)
+    row_offsets64 = np.ascontiguousarray(row_offsets, dtype=np.int64)
+    n = row_offsets64.shape[0] - 1
+    starts = _i32(layout.column_starts)
+    sizes = _i32(layout.column_sizes)
+    is_var = np.asarray([dt.is_variable_width for dt in schema],
+                        dtype=np.uint8)
+    p_i32 = ctypes.POINTER(ctypes.c_int32)
+    p_i64 = ctypes.POINTER(ctypes.c_int64)
+    p_u8 = ctypes.POINTER(ctypes.c_uint8)
+
+    out_data: list[np.ndarray | None] = []
+    out_valid = []
+    out_str_offsets = []
+    for dt in schema:
+        if dt.is_variable_width:
+            out_data.append(None)
+            out_str_offsets.append(np.zeros(n + 1, dtype=np.int32))
+        else:
+            out_data.append(np.empty(n * dt.itemsize, dtype=np.uint8))
+        out_valid.append(np.empty(n, dtype=np.uint8))
+
+    if layout.fixed_width_only:
+        lib.srjt_unpack_fixed(
+            row_bytes.ctypes.data_as(p_u8), n, layout.fixed_row_size,
+            starts.ctypes.data_as(p_i32), sizes.ctypes.data_as(p_i32),
+            len(schema), layout.validity_offset,
+            _ptr_array(out_data), _ptr_array(out_valid))
+        chars = {}
+    else:
+        lib.srjt_unpack_var(
+            row_bytes.ctypes.data_as(p_u8),
+            row_offsets64.ctypes.data_as(p_i64), n,
+            starts.ctypes.data_as(p_i32), sizes.ctypes.data_as(p_i32),
+            is_var.ctypes.data_as(p_u8), len(schema), layout.validity_offset,
+            _ptr_array(out_data),   # indexed by column; var slots stay null
+            _ptr_array(out_str_offsets), _ptr_array(out_valid))
+        chars = {}
+        for vi, ci in enumerate(layout.variable_column_indices):
+            offs = out_str_offsets[vi]
+            buf = np.empty(int(offs[-1]), dtype=np.uint8)
+            lib.srjt_gather_chars(
+                row_bytes.ctypes.data_as(p_u8),
+                row_offsets64.ctypes.data_as(p_i64), n,
+                layout.column_starts[ci], offs.ctypes.data_as(p_i32),
+                buf.ctypes.data_as(p_u8))
+            chars[ci] = (buf, offs)
+
+    import jax.numpy as jnp
+    cols = []
+    for ci, dt in enumerate(schema):
+        valid = out_valid[ci].astype(bool)
+        v = None if valid.all() else jnp.asarray(valid)
+        if dt.is_variable_width:
+            buf, offs = chars[ci]
+            cols.append(Column(dt, jnp.asarray(buf), jnp.asarray(offs), v))
+        else:
+            arr = out_data[ci].view(dt.storage)
+            cols.append(Column.from_numpy(arr, dt,
+                                          None if v is None else valid))
+    return Table(cols)
