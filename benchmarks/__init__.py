@@ -1,0 +1,8 @@
+"""Manual benchmark suite (nvbench-harness equivalent, SURVEY §2.8).
+
+Like the reference's ``src/main/cpp/benchmarks`` (nvbench, never run in CI —
+``CONTRIBUTING.md:223-231``), these are run by hand:
+
+    python -m benchmarks.row_conversion            # quick axes
+    python -m benchmarks.row_conversion --full     # the reference's axes
+"""

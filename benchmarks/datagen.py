@@ -1,0 +1,67 @@
+"""Random-table generation for benchmarks.
+
+Equivalent of the cudf datagen library the reference benchmarks link
+(``create_random_table``, ``benchmarks/row_conversion.cpp:31,105``;
+``benchmarks/CMakeLists.txt:18-21``): build a table from a cycled dtype
+schema with configurable null fraction and random strings.
+"""
+
+from __future__ import annotations
+
+import string as _string
+from typing import Optional, Sequence
+
+import numpy as np
+
+import spark_rapids_jni_tpu as sr
+from spark_rapids_jni_tpu import Column, Table
+
+# The reference's fixed-width bench cycles int8/16/32/64, float, bool over
+# 212 columns (``benchmarks/row_conversion.cpp:38-47``); f64 excluded here
+# for the same reason as bench.py (XLA:TPU f64 payloads stage via host).
+FIXED_CYCLE = [sr.int64, sr.int32, sr.int16, sr.int8, sr.float32, sr.bool8]
+
+
+def cycled_schema(n_cols: int, include_strings: bool = False,
+                  string_every: int = 10):
+    """n_cols-wide schema cycling FIXED_CYCLE, optionally a string column
+    every ``string_every`` slots (the variable-width bench mixes ~1/10,
+    ``benchmarks/row_conversion.cpp:74-88``)."""
+    schema = []
+    for i in range(n_cols):
+        if include_strings and i % string_every == 0:
+            schema.append(sr.string)
+        else:
+            schema.append(FIXED_CYCLE[i % len(FIXED_CYCLE)])
+    return schema
+
+
+def random_column(dt, n_rows: int, rng: np.random.Generator,
+                  null_probability: Optional[float] = 0.1,
+                  max_string_len: int = 32) -> Column:
+    validity = (rng.random(n_rows) >= null_probability
+                if null_probability else None)
+    if dt == sr.string:
+        alphabet = np.array(list(_string.ascii_letters + _string.digits))
+        lens = rng.integers(0, max_string_len, n_rows)
+        strs = ["".join(rng.choice(alphabet, size=l)) for l in lens]
+        if validity is not None:
+            strs = [s if v else None for s, v in zip(strs, validity)]
+        return Column.strings_from_list(strs)
+    st = dt.storage
+    if st.kind == "f":
+        arr = rng.standard_normal(n_rows).astype(st)
+    elif dt == sr.bool8:
+        arr = rng.integers(0, 2, n_rows).astype(np.uint8)
+    else:
+        info = np.iinfo(st)
+        arr = rng.integers(info.min // 2, info.max // 2, n_rows, dtype=st)
+    return Column.from_numpy(arr, dt, validity)
+
+
+def create_random_table(schema: Sequence, n_rows: int, seed: int = 0,
+                        null_probability: Optional[float] = 0.1,
+                        max_string_len: int = 32) -> Table:
+    rng = np.random.default_rng(seed)
+    return Table([random_column(dt, n_rows, rng, null_probability,
+                                max_string_len) for dt in schema])

@@ -1,0 +1,88 @@
+"""Row-conversion microbenchmarks — the reference's nvbench axes on TPU.
+
+Mirrors ``src/main/cpp/benchmarks/row_conversion.cpp``:
+
+* ``fixed_width``: 212-column cycled fixed-width schema × {1M, 4M} rows ×
+  {to row, from row} (``:27-67, 140-143``).
+* ``variable_or_fixed``: 155-column schema × {strings, no strings} ×
+  direction, string states above 1M rows skipped ("memory issues",
+  ``:117-120, 145-149``).
+
+Throughput counts the JCUDF row bytes moved once per direction, the analog
+of nvbench's global-memory-read summary.
+
+Usage:  python -m benchmarks.row_conversion [--full] [--json OUT.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+
+from spark_rapids_jni_tpu import convert_to_rows, convert_from_rows
+
+from .datagen import create_random_table, cycled_schema
+from .harness import Bench, report
+
+FIXED_COLS = 212       # benchmarks/row_conversion.cpp:38
+VARIABLE_COLS = 155    # benchmarks/row_conversion.cpp:74
+
+
+def _row_conversion_bench(state):
+    n_rows = state["rows"]
+    with_strings = state.params.get("strings", False)
+    n_cols = VARIABLE_COLS if "strings" in state.params else FIXED_COLS
+    # short strings keep the 155-col row under the 1KB JCUDF row limit
+    table = create_random_table(
+        cycled_schema(n_cols, include_strings=with_strings), n_rows,
+        max_string_len=10)
+    batches = convert_to_rows(table)
+    state.bytes_per_iter = sum(b.num_bytes for b in batches)
+
+    if state["direction"] == "to_row":
+        def closure():
+            return [b.data for b in convert_to_rows(table)]
+    else:
+        schema = table.schema
+
+        def closure():
+            outs = []
+            for b in batches:
+                outs.extend(c.data for c in
+                            convert_from_rows(b, schema).columns)
+            return outs
+    return closure
+
+
+def build_benches(full: bool):
+    rows = [1 << 20, 4 << 20] if full else [1 << 18]
+    fixed = Bench("fixed_width", _row_conversion_bench,
+                  axes={"rows": rows, "direction": ["to_row", "from_row"]})
+    variable = Bench(
+        "variable_or_fixed", _row_conversion_bench,
+        axes={"rows": rows, "direction": ["to_row", "from_row"],
+              "strings": [False, True]},
+        # reference skips string states above 1M rows (:117-120)
+        skip=lambda s: ("string case skipped above 1M rows"
+                        if s["strings"] and s["rows"] > (1 << 20) else None))
+    return [fixed, variable]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true",
+                    help="the reference's full 1M/4M axes")
+    ap.add_argument("--json", default=None, help="write JSON lines here")
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+
+    print(f"devices: {jax.devices()}", flush=True)
+    results = []
+    for bench in build_benches(args.full):
+        results.extend(bench.run(iters=args.iters))
+    report(results, args.json)
+
+
+if __name__ == "__main__":
+    main()

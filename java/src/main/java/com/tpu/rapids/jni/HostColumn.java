@@ -1,0 +1,80 @@
+/*
+ * A host-resident column handle backed by native memory.
+ *
+ * The TPU framework's stand-in for the cudf Java ColumnVector the reference
+ * API trades in (RowConversion.java:101-110): fixed-width payload bytes or
+ * string chars + int32 Arrow offsets, with an optional byte-per-row
+ * validity vector.  Native ownership follows the reference's handle
+ * protocol — the creator owns the handle until close().
+ */
+package com.tpu.rapids.jni;
+
+public final class HostColumn implements AutoCloseable {
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  private long handle;
+  private final int typeId;
+  private final int scale;
+
+  private HostColumn(long handle, int typeId, int scale) {
+    this.handle = handle;
+    this.typeId = typeId;
+    this.scale = scale;
+  }
+
+  /**
+   * Builds a fixed-width column by copying {@code rowCount * typeSize}
+   * little-endian bytes from {@code dataAddress}.  {@code validAddress} is
+   * a byte-per-row validity vector, or 0 for all-valid.
+   */
+  public static HostColumn fromFixedWidth(int typeId, int scale, long rowCount,
+      long dataAddress, long validAddress) {
+    long h = makeFixed(typeId, scale, rowCount, dataAddress, validAddress);
+    return new HostColumn(h, typeId, scale);
+  }
+
+  /** Builds a string column from Arrow offsets ({@code rowCount+1} int32s)
+   *  and a chars buffer. */
+  public static HostColumn fromStrings(long rowCount, long offsetsAddress,
+      long charsAddress, long validAddress) {
+    long h = makeString(rowCount, offsetsAddress, charsAddress, validAddress);
+    return new HostColumn(h, /*STRING=*/24, 0);
+  }
+
+  static HostColumn wrap(long handle, int typeId, int scale) {
+    return new HostColumn(handle, typeId, scale);
+  }
+
+  public long getNativeHandle() {
+    if (handle == 0) {
+      throw new IllegalStateException("column closed");
+    }
+    return handle;
+  }
+
+  public int getTypeId() {
+    return typeId;
+  }
+
+  public int getScale() {
+    return scale;
+  }
+
+  @Override
+  public void close() {
+    if (handle != 0) {
+      close(handle);
+      handle = 0;
+    }
+  }
+
+  private static native long makeFixed(int typeId, int scale, long rowCount,
+      long dataAddress, long validAddress);
+
+  private static native long makeString(long rowCount, long offsetsAddress,
+      long charsAddress, long validAddress);
+
+  private static native void close(long handle);
+}

@@ -1,0 +1,68 @@
+/*
+ * An ordered set of equal-length HostColumns (the cudf Table analog).
+ *
+ * Columns are shared, not owned: closing the table releases the table's
+ * references while column handles stay valid until their own close() — the
+ * same refcount discipline the reference inherits from cudf Java.
+ */
+package com.tpu.rapids.jni;
+
+public final class HostTable implements AutoCloseable {
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  private long handle;
+
+  private HostTable(long handle) {
+    this.handle = handle;
+  }
+
+  public static HostTable fromColumns(HostColumn... columns) {
+    long[] handles = new long[columns.length];
+    for (int i = 0; i < columns.length; i++) {
+      handles[i] = columns[i].getNativeHandle();
+    }
+    return new HostTable(makeTable(handles));
+  }
+
+  static HostTable wrap(long handle) {
+    return new HostTable(handle);
+  }
+
+  public long getNativeHandle() {
+    if (handle == 0) {
+      throw new IllegalStateException("table closed");
+    }
+    return handle;
+  }
+
+  public long getRowCount() {
+    return rowCount(getNativeHandle());
+  }
+
+  /**
+   * Releases each column as an independently-owned handle — the
+   * convert_table_for_return protocol (RowConversionJni.cpp:33-38).
+   * Caller closes each returned handle.
+   */
+  public long[] releaseColumns() {
+    return columns(getNativeHandle());
+  }
+
+  @Override
+  public void close() {
+    if (handle != 0) {
+      close(handle);
+      handle = 0;
+    }
+  }
+
+  private static native long makeTable(long[] columnHandles);
+
+  private static native long rowCount(long handle);
+
+  private static native long[] columns(long handle);
+
+  private static native void close(long handle);
+}

@@ -1,0 +1,56 @@
+/*
+ * Loader for the single native artifact libsrjt.so.
+ *
+ * Capability parity with the reference's NativeDepsLoader.loadNativeDeps()
+ * class-init protocol (RowConversion.java:23-25 in spark-rapids-jni): every
+ * API class triggers this loader before first native call.  The library is
+ * located via -Dsrjt.native.path, java.library.path, or a resource embedded
+ * under /<os.arch>/<os.name>/ in the jar (pom.xml:450-471 analog).
+ */
+package com.tpu.rapids.jni;
+
+import java.io.File;
+import java.io.IOException;
+import java.io.InputStream;
+import java.nio.file.Files;
+import java.nio.file.Path;
+import java.nio.file.StandardCopyOption;
+
+public final class NativeDepsLoader {
+  private static boolean loaded = false;
+
+  private NativeDepsLoader() {}
+
+  public static synchronized void loadNativeDeps() {
+    if (loaded) {
+      return;
+    }
+    String explicit = System.getProperty("srjt.native.path");
+    if (explicit != null) {
+      System.load(new File(explicit).getAbsolutePath());
+      loaded = true;
+      return;
+    }
+    try {
+      System.loadLibrary("srjt");
+      loaded = true;
+      return;
+    } catch (UnsatisfiedLinkError ignored) {
+      // fall through to the embedded resource
+    }
+    String resource = "/" + System.getProperty("os.arch") + "/"
+        + System.getProperty("os.name") + "/libsrjt.so";
+    try (InputStream in = NativeDepsLoader.class.getResourceAsStream(resource)) {
+      if (in == null) {
+        throw new UnsatisfiedLinkError("libsrjt.so not found: " + resource);
+      }
+      Path tmp = Files.createTempFile("libsrjt", ".so");
+      Files.copy(in, tmp, StandardCopyOption.REPLACE_EXISTING);
+      tmp.toFile().deleteOnExit();
+      System.load(tmp.toAbsolutePath().toString());
+      loaded = true;
+    } catch (IOException e) {
+      throw new UnsatisfiedLinkError("failed to extract libsrjt.so: " + e);
+    }
+  }
+}

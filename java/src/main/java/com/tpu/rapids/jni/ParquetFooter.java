@@ -1,0 +1,158 @@
+/*
+ * Handle to a native-parsed, pruned Parquet footer.
+ *
+ * Capability parity with the reference's ParquetFooter (ParquetFooter.java
+ * :27-235): a schema DSL describing the columns Spark expects, a
+ * depth-first flattening into parallel names/numChildren/tags arrays for
+ * cheap JNI transfer, readAndFilter (thrift parse + column prune + row
+ * group selection by split midpoint), and PAR1-framed re-serialization.
+ */
+package com.tpu.rapids.jni;
+
+import java.util.ArrayList;
+import java.util.List;
+
+public final class ParquetFooter implements AutoCloseable {
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  // tags match the native engine (footer_engine.cpp; reference
+  // NativeParquetJni.cpp Tag{VALUE,STRUCT,LIST,MAP})
+  private static final int TAG_VALUE = 0;
+  private static final int TAG_STRUCT = 1;
+  private static final int TAG_LIST = 2;
+  private static final int TAG_MAP = 3;
+
+  /** Base of the expected-schema DSL (ParquetFooter.java:35-93 analog). */
+  public abstract static class SchemaElement {
+    final String name;
+    final int tag;
+    final List<SchemaElement> children = new ArrayList<>();
+
+    SchemaElement(String name, int tag) {
+      this.name = name;
+      this.tag = tag;
+    }
+  }
+
+  public static final class ValueElement extends SchemaElement {
+    public ValueElement(String name) {
+      super(name, TAG_VALUE);
+    }
+  }
+
+  public static final class StructElement extends SchemaElement {
+    public StructElement(String name, SchemaElement... kids) {
+      super(name, TAG_STRUCT);
+      for (SchemaElement k : kids) {
+        children.add(k);
+      }
+    }
+  }
+
+  public static final class ListElement extends SchemaElement {
+    public ListElement(String name, SchemaElement element) {
+      super(name, TAG_LIST);
+      children.add(element);
+    }
+  }
+
+  public static final class MapElement extends SchemaElement {
+    public MapElement(String name, SchemaElement key, SchemaElement value) {
+      super(name, TAG_MAP);
+      children.add(key);
+      children.add(value);
+    }
+  }
+
+  private long handle;
+
+  private ParquetFooter(long handle) {
+    this.handle = handle;
+  }
+
+  /**
+   * Parse + prune the raw footer bytes at {@code bufferAddress}: keep only
+   * columns present in {@code schema} (case-folded when
+   * {@code ignoreCase}), and only row groups whose byte midpoint lies in
+   * [partOffset, partOffset+partLength).
+   */
+  public static ParquetFooter readAndFilter(long bufferAddress,
+      long bufferLength, long partOffset, long partLength,
+      StructElement schema, boolean ignoreCase) {
+    List<String> names = new ArrayList<>();
+    List<Integer> numChildren = new ArrayList<>();
+    List<Integer> tags = new ArrayList<>();
+    depthFirst(schema, names, numChildren, tags);
+    int n = names.size();
+    int[] nc = new int[n];
+    int[] tg = new int[n];
+    String[] nm = new String[n];
+    for (int i = 0; i < n; i++) {
+      nm[i] = ignoreCase ? names.get(i).toLowerCase() : names.get(i);
+      nc[i] = numChildren.get(i);
+      tg[i] = tags.get(i);
+    }
+    long h = readAndFilter(bufferAddress, bufferLength, partOffset,
+        partLength, nm, nc, tg, schema.children.size(), ignoreCase);
+    return new ParquetFooter(h);
+  }
+
+  /** Depth-first flattening, root excluded (ParquetFooter.java:136-185). */
+  private static void depthFirst(SchemaElement node, List<String> names,
+      List<Integer> numChildren, List<Integer> tags) {
+    for (SchemaElement c : node.children) {
+      names.add(c.name);
+      numChildren.add(c.children.size());
+      tags.add(c.tag);
+      depthFirst(c, names, numChildren, tags);
+    }
+  }
+
+  public long getNumRows() {
+    return getNumRows(getNativeHandle());
+  }
+
+  public long getNumColumns() {
+    return getNumColumns(getNativeHandle());
+  }
+
+  /**
+   * Re-serialize as a standalone thrift "file": PAR1 + compact-protocol
+   * footer + length + PAR1 (NativeParquetJni.cpp:666-699 framing).
+   * Returns bytes written into the caller's buffer.
+   */
+  public long serializeThriftFile(long outAddress, long outCapacity) {
+    return serializeThriftFile(getNativeHandle(), outAddress, outCapacity);
+  }
+
+  private long getNativeHandle() {
+    if (handle == 0) {
+      throw new IllegalStateException("footer closed");
+    }
+    return handle;
+  }
+
+  @Override
+  public void close() {
+    if (handle != 0) {
+      close(handle);
+      handle = 0;
+    }
+  }
+
+  private static native long readAndFilter(long bufferAddress,
+      long bufferLength, long partOffset, long partLength, String[] names,
+      int[] numChildren, int[] tags, int parentNumChildren,
+      boolean ignoreCase);
+
+  private static native long getNumRows(long handle);
+
+  private static native long getNumColumns(long handle);
+
+  private static native long serializeThriftFile(long handle, long outAddress,
+      long outCapacity);
+
+  private static native void close(long handle);
+}

@@ -122,6 +122,9 @@ def _unstage(data: jnp.ndarray, storage: np.dtype) -> jnp.ndarray:
 @functools.partial(jax.jit, static_argnums=0)
 def _to_rows_fixed(layout: RowLayout, datas: tuple[jnp.ndarray, ...],
                    valid: jnp.ndarray) -> jnp.ndarray:
+    from . import pallas_kernels
+    if pallas_kernels.fixed_pallas_enabled():
+        return pallas_kernels.to_rows_fixed(layout, tuple(datas), valid)
     n = valid.shape[0]
     out = jnp.zeros((n, layout.fixed_row_size), dtype=jnp.uint8)
     for ci, dt in enumerate(layout.schema):
@@ -137,6 +140,9 @@ def _to_rows_fixed(layout: RowLayout, datas: tuple[jnp.ndarray, ...],
 @functools.partial(jax.jit, static_argnums=0)
 def _from_rows_fixed(layout: RowLayout, rows: jnp.ndarray):
     """uint8 [n, fixed_row_size] → (datas tuple, valid bool [n, ncols])."""
+    from . import pallas_kernels
+    if pallas_kernels.fixed_pallas_enabled():
+        return pallas_kernels.from_rows_fixed(layout, rows)
     datas = []
     for ci, dt in enumerate(layout.schema):
         start = layout.column_starts[ci]

@@ -1,0 +1,330 @@
+"""Pallas TPU kernels for the fixed-width JCUDF transcode hot path.
+
+The reference's hot loops are shared-memory tiled CUDA kernels
+(``copy_to_rows`` / ``copy_from_rows`` / ``copy_validity_to_rows``,
+``row_conversion.cu:575-693, 892-993, 710-810``): stage a 2-D tile of the
+table in shmem in row layout, then blast it to global memory coalesced.
+
+The TPU-native equivalent here works at *word* granularity instead of byte
+granularity: a JCUDF row is a sequence of ``W = row_size/4`` little-endian
+u32 words, and because every fixed-width column slot is aligned to its own
+size (``compute_column_information``, ``row_conversion.cu:1331-1370``), each
+word is composed of a *static* set of column fragments — a full int32, half
+of an int64, or shifted int8/int16/validity bytes sharing one word.  The
+kernel tiles rows through VMEM and materialises each output word with a
+statically unrolled shift/or tree, fusing the data transpose and the
+validity bit-pack (the ``__ballot_sync`` analog) into one pass: one HBM read
+per column, one HBM write of the packed rows.  The tile/batch machinery of
+the reference becomes the static grid spec — no runtime tile metadata.
+
+Dispatch: :func:`fixed_pallas_enabled` turns the kernels on automatically on
+TPU backends (after a one-shot smoke test), and always under
+``SRJT_PALLAS=1`` / never under ``SRJT_PALLAS=0``.  The XLA path in
+``convert.py`` remains the correctness oracle; tests run these kernels in
+interpret mode on CPU and byte-compare against it.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from .. import types as T
+from ..utils import bitmask
+from .layout import RowLayout
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _is_f64(storage: np.dtype) -> bool:
+    return storage.kind == "f" and storage.itemsize == 8
+
+
+# ---------------------------------------------------------------------------
+# static word-composition plan
+# ---------------------------------------------------------------------------
+
+def _word_plan(layout: RowLayout):
+    """For each u32 word of the row, the static list of fragments.
+
+    Fragment = (input_index, kind, arg):
+      kind 'full'  — input is u32 [n], the whole word                (size 4)
+      kind 'pair'  — input is u32 [n, 2], arg selects the half       (size 8)
+      kind 'sub'   — input is u8/u16 [n], arg = byte shift in word   (size <4)
+      kind 'vbyte' — input is u8 [n, vb], arg = (byte index, shift)
+    Input order: one staged array per column, then the validity bytes.
+    """
+    W = layout.fixed_row_size // 4
+    plan: list[list[tuple[int, str, object]]] = [[] for _ in range(W)]
+    for ci, dt in enumerate(layout.schema):
+        start = layout.column_starts[ci]
+        size = layout.column_sizes[ci]
+        if size == 8:
+            plan[start // 4].append((ci, "pair", 0))
+            plan[start // 4 + 1].append((ci, "pair", 1))
+        elif size == 4:
+            plan[start // 4].append((ci, "full", None))
+        else:  # 1 or 2; alignment guarantees it stays inside one word
+            plan[start // 4].append((ci, "sub", start % 4))
+    vi = layout.num_columns
+    vo = layout.validity_offset
+    for k in range(layout.validity_bytes):
+        byte = vo + k
+        plan[byte // 4].append((vi, "vbyte", (k, byte % 4)))
+    return plan
+
+
+def _stage_column(data: jnp.ndarray, storage: np.dtype) -> jnp.ndarray:
+    """Column payload → the kernel's staged form (see :func:`_word_plan`).
+
+    Everything becomes u32 so that every kernel operand shares XLA:TPU's
+    u32 tiled layout (Mosaic rejects mixed 1-D tilings): 8-byte columns as
+    u32 [n, 2] halves, 4-byte columns bitcast, sub-word columns zero-
+    extended (their shift/or placement masks nothing, so no masking is
+    needed in-kernel).  FLOAT64 arrives pre-staged as u32 [n, 2] (XLA:TPU
+    emulates f64 — see ``convert._stage``).
+    """
+    if _is_f64(storage):
+        return data  # already u32 [n, 2]
+    data = data.astype(storage)
+    if storage.itemsize == 8:
+        return jax.lax.bitcast_convert_type(data, jnp.uint32)   # [n, 2]
+    if storage.itemsize == 4:
+        return jax.lax.bitcast_convert_type(data, jnp.uint32)   # [n]
+    unsigned = np.dtype(f"u{storage.itemsize}")
+    return jax.lax.bitcast_convert_type(data, unsigned).astype(jnp.uint32)
+
+
+def _unstage_column(staged: jnp.ndarray, storage: np.dtype) -> jnp.ndarray:
+    if _is_f64(storage):
+        return staged  # keep the u32 [n, 2] staging convention
+    if storage.itemsize < 4:
+        unsigned = np.dtype(f"u{storage.itemsize}")
+        return jax.lax.bitcast_convert_type(
+            staged.astype(jnp.dtype(unsigned)), jnp.dtype(storage))
+    return jax.lax.bitcast_convert_type(staged, jnp.dtype(storage))
+
+
+def _pad_rows(x: jnp.ndarray, n_pad: int) -> jnp.ndarray:
+    n = x.shape[0]
+    if n == n_pad:
+        return x
+    pad = [(0, n_pad - n)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, pad)
+
+
+def _tile_rows(n: int) -> tuple[int, int]:
+    """(rows per grid step, padded row count).
+
+    1024 rows/tile: 1-D u32 operands carry XLA:TPU's {0:T(1024)} tiled
+    layout and Mosaic requires the block shape to be a multiple of it
+    (2-D operands only need sublane multiples of 32, which 1024 also is).
+    """
+    tr = 1024
+    return tr, _round_up(max(n, 1), tr)
+
+
+# ---------------------------------------------------------------------------
+# pack: columns (+ validity matrix) → JCUDF row words
+# ---------------------------------------------------------------------------
+
+def to_rows_fixed(layout: RowLayout, datas: Sequence[jnp.ndarray],
+                  valid: jnp.ndarray, *, interpret: bool = False) -> jnp.ndarray:
+    """Fixed-width pack on TPU via Pallas → uint8 [n, fixed_row_size].
+
+    Same contract as ``convert._to_rows_fixed`` (the XLA oracle):
+    ``datas`` per-column payloads (f64 staged as u32 [n, 2]), ``valid``
+    bool [n, ncols].
+    """
+    n = valid.shape[0]
+    W = layout.fixed_row_size // 4
+    plan = _word_plan(layout)
+    tr, n_pad = _tile_rows(n)
+
+    staged = [_pad_rows(_stage_column(d, dt.storage), n_pad)
+              for d, dt in zip(datas, layout.schema)]
+    # validity bytes widened to u32: Mosaic mishandles narrow-laned u8
+    # blocks (observed: zeroed loads from a (tr, 2) u8 block on v5e)
+    vbytes = _pad_rows(
+        bitmask.pack_bool_matrix(valid).astype(jnp.uint32), n_pad)
+    inputs = staged + [vbytes]
+
+    def kernel(*refs):
+        in_refs, out_ref = refs[:-1], refs[-1]
+        loaded = [r[...] for r in in_refs]
+        words = []
+        for w in range(W):
+            acc = None
+            for ii, kind, arg in plan[w]:
+                x = loaded[ii]
+                if kind == "full":
+                    v = x
+                elif kind == "pair":
+                    v = x[:, arg]
+                elif kind == "sub":
+                    # multiply, not <<: Mosaic (v5e, jax 0.8) miscompiles
+                    # shl-by-16 of a lane-sliced narrow block to zero
+                    v = x * jnp.uint32(1 << (arg * 8))
+                else:  # vbyte
+                    k, shift = arg
+                    v = x[:, k] * jnp.uint32(1 << (shift * 8))
+                acc = v if acc is None else acc | v
+            words.append(acc if acc is not None
+                         else jnp.zeros((tr,), jnp.uint32))
+        out_ref[...] = jnp.stack(words, axis=1)
+
+    def spec(a):
+        if a.ndim == 1:
+            return pl.BlockSpec((tr,), lambda i: (i,))
+        return pl.BlockSpec((tr, a.shape[1]), lambda i: (i, jnp.int32(0)))
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // tr,),
+        in_specs=[spec(a) for a in inputs],
+        out_specs=pl.BlockSpec((tr, W), lambda i: (i, jnp.int32(0))),
+        out_shape=jax.ShapeDtypeStruct((n_pad, W), jnp.uint32),
+        interpret=interpret,
+    )(*inputs)
+
+    rows = jax.lax.bitcast_convert_type(out, jnp.uint8)  # [n_pad, W, 4]
+    return rows.reshape(n_pad, layout.fixed_row_size)[:n]
+
+
+# ---------------------------------------------------------------------------
+# unpack: JCUDF row words → columns (+ validity matrix)
+# ---------------------------------------------------------------------------
+
+def from_rows_fixed(layout: RowLayout, rows: jnp.ndarray,
+                    *, interpret: bool = False):
+    """Inverse of :func:`to_rows_fixed`: uint8 [n, row_size] → (datas, valid)."""
+    n = rows.shape[0]
+    W = layout.fixed_row_size // 4
+    tr, n_pad = _tile_rows(n)
+    vo, vb = layout.validity_offset, layout.validity_bytes
+
+    rows32 = jax.lax.bitcast_convert_type(
+        _pad_rows(rows, n_pad).reshape(n_pad, W, 4), jnp.uint32)
+
+    out_shapes, col_plan = [], []
+    for ci, dt in enumerate(layout.schema):
+        start, size = layout.column_starts[ci], layout.column_sizes[ci]
+        if size == 8:
+            out_shapes.append(jax.ShapeDtypeStruct((n_pad, 2), jnp.uint32))
+            col_plan.append(("pair", start // 4))
+        elif size == 4:
+            out_shapes.append(jax.ShapeDtypeStruct((n_pad,), jnp.uint32))
+            col_plan.append(("full", start // 4))
+        else:
+            out_shapes.append(jax.ShapeDtypeStruct((n_pad,), jnp.uint32))
+            col_plan.append(("sub", (start // 4, start % 4, size)))
+    # u32 lanes for the validity bytes (same Mosaic narrow-u8-block issue
+    # as the pack side); narrowed back outside the kernel
+    out_shapes.append(jax.ShapeDtypeStruct((n_pad, vb), jnp.uint32))
+
+    def kernel(rows_ref, *out_refs):
+        r = rows_ref[...]  # [tr, W] u32
+        for (kind, arg), oref in zip(col_plan, out_refs[:-1]):
+            if kind == "pair":
+                oref[...] = jnp.stack([r[:, arg], r[:, arg + 1]], axis=1)
+            elif kind == "full":
+                oref[...] = r[:, arg]
+            else:
+                w, shift, width = arg
+                oref[...] = (r[:, w] >> (shift * 8)) & ((1 << (8 * width)) - 1)
+        vwords = []
+        for k in range(vb):
+            byte = vo + k
+            vwords.append((r[:, byte // 4] >> ((byte % 4) * 8)) & 0xFF)
+        out_refs[-1][...] = jnp.stack(vwords, axis=1)
+
+    def spec(s):
+        if len(s.shape) == 1:
+            return pl.BlockSpec((tr,), lambda i: (i,))
+        return pl.BlockSpec((tr, s.shape[1]), lambda i: (i, jnp.int32(0)))
+
+    outs = pl.pallas_call(
+        kernel,
+        grid=(n_pad // tr,),
+        in_specs=[pl.BlockSpec((tr, W), lambda i: (i, jnp.int32(0)))],
+        out_specs=[spec(s) for s in out_shapes],
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(rows32)
+
+    datas = tuple(
+        _unstage_column(o[:n], dt.storage)
+        for o, dt in zip(outs[:-1], layout.schema))
+    valid = bitmask.unpack_bool_matrix(
+        outs[-1][:n].astype(jnp.uint8), layout.num_columns)
+    return datas, valid
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+_decision: Optional[bool] = None
+
+
+def _smoke_test() -> None:
+    """Byte-exact round trip on a schema that exercises every word-fragment
+    shift (0/8/16/24): int16@4, int8@6, validity@7 share word 1.  Compared
+    against a NumPy-packed oracle so a Mosaic miscompile (e.g. the
+    shl-by-16 bug worked around above) downgrades dispatch to XLA."""
+    from .layout import compute_row_layout
+    layout = compute_row_layout([T.int32, T.int16, T.int8])
+    n = 16
+    rng = np.random.default_rng(0)
+    a32 = rng.integers(-2**31, 2**31, n).astype(np.int32)
+    a16 = rng.integers(-2**15, 2**15, n).astype(np.int16)
+    a8 = rng.integers(-128, 128, n).astype(np.int8)
+    valid_np = rng.random((n, 3)) < 0.5
+    expect = np.zeros((n, 8), dtype=np.uint8)
+    expect[:, 0:4] = a32.view(np.uint8).reshape(n, 4)
+    expect[:, 4:6] = a16.view(np.uint8).reshape(n, 2)
+    expect[:, 6:7] = a8.view(np.uint8).reshape(n, 1)
+    expect[:, 7] = np.packbits(valid_np, axis=1, bitorder="little")[:, 0]
+
+    datas = (jnp.asarray(a32), jnp.asarray(a16), jnp.asarray(a8))
+    valid = jnp.asarray(valid_np)
+    rows = to_rows_fixed(layout, datas, valid)
+    np.testing.assert_array_equal(np.asarray(rows), expect)
+    back, v = from_rows_fixed(layout, rows)
+    for got, want in zip(back, (a32, a16, a8)):
+        np.testing.assert_array_equal(np.asarray(got), want)
+    np.testing.assert_array_equal(np.asarray(v), valid_np)
+
+
+def fixed_pallas_enabled() -> bool:
+    """True when the fixed-width transcode should route through Pallas.
+
+    ``SRJT_PALLAS=1`` forces on (errors surface), ``=0`` forces off;
+    default: on iff the backend is TPU and a one-shot smoke round-trip
+    passes (so a kernel/toolchain regression degrades to the XLA path
+    instead of failing the call).
+    """
+    global _decision
+    env = os.environ.get("SRJT_PALLAS", "auto").lower()
+    if env in ("0", "off", "false"):
+        return False
+    if env in ("1", "on", "true"):
+        return True
+    if _decision is None:
+        if jax.default_backend() != "tpu":
+            _decision = False
+        else:
+            try:
+                _smoke_test()
+                _decision = True
+            except Exception:
+                _decision = False
+    return _decision
