@@ -15,15 +15,64 @@ vs_baseline  = device GB/s / vectorized-NumPy-host GB/s on the same workload.
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
 import jax
-import spark_rapids_jni_tpu as sr
-from spark_rapids_jni_tpu import Column, Table, convert_to_rows, convert_from_rows
-from spark_rapids_jni_tpu.rowconv import host as host_engine
+
+
+def _emit(payload: dict) -> None:
+    print(json.dumps(payload))
+    sys.stdout.flush()
+
+
+def _probe_backend(max_tries: int = 3) -> list:
+    """Initialize the JAX backend, re-execing to retry transient failures.
+
+    Round-1 postmortem: a one-shot ``Unable to initialize backend`` traceback
+    produced rc=1 and no JSON at all (BENCH_r01.json parsed:null).  Backend
+    init failure is cached process-wide by JAX, so retries must come from a
+    fresh process: re-exec with a counter.  After the budget is spent, emit a
+    JSON line with an "error" key and exit 0 so the driver always records a
+    parseable result.
+    """
+    try:
+        return jax.devices()
+    except Exception as e:  # noqa: BLE001 — any init failure handled the same
+        tries = int(os.environ.get("SRJT_BENCH_TRIES", "0"))
+        if tries < max_tries:
+            os.environ["SRJT_BENCH_TRIES"] = str(tries + 1)
+            time.sleep(5)  # short: a driver timeout must not outrun the JSON
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+        _emit({
+            "metric": "jcudf_row_conversion_roundtrip_1M",
+            "value": 0.0,
+            "unit": "GB/s",
+            "vs_baseline": 0.0,
+            "error": f"backend init failed after {max_tries} retries: {e!r}",
+        })
+        sys.exit(0)
+
+
+_DEVICES = _probe_backend()
+
+try:
+    import spark_rapids_jni_tpu as sr
+    from spark_rapids_jni_tpu import (Column, Table, convert_to_rows,
+                                      convert_from_rows)
+    from spark_rapids_jni_tpu.rowconv import host as host_engine
+except Exception as e:  # noqa: BLE001 — import failure must still yield JSON
+    _emit({
+        "metric": "jcudf_row_conversion_roundtrip_1M",
+        "value": 0.0,
+        "unit": "GB/s",
+        "vs_baseline": 0.0,
+        "error": f"package import failed: {e!r}",
+    })
+    sys.exit(0)
 
 N_ROWS = 1_000_000
 # 12-column cycled fixed-width schema (int64-heavy per BASELINE config #1;
@@ -93,13 +142,24 @@ def main():
     dev_gbps = transcoded / dev_s / 1e9
     host_gbps = transcoded / host_s / 1e9
 
-    print(json.dumps({
+    _emit({
         "metric": "jcudf_row_conversion_roundtrip_1M",
         "value": round(dev_gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(dev_gbps / host_gbps, 3),
-    }))
+        "backend": _DEVICES[0].platform,
+    })
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — the driver needs a JSON line, always
+        _emit({
+            "metric": "jcudf_row_conversion_roundtrip_1M",
+            "value": 0.0,
+            "unit": "GB/s",
+            "vs_baseline": 0.0,
+            "error": repr(e),
+        })
+        sys.exit(0)
