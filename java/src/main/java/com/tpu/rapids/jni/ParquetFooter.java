@@ -90,7 +90,7 @@ public final class ParquetFooter implements AutoCloseable {
     int[] tg = new int[n];
     String[] nm = new String[n];
     for (int i = 0; i < n; i++) {
-      nm[i] = ignoreCase ? names.get(i).toLowerCase() : names.get(i);
+      nm[i] = ignoreCase ? names.get(i).toLowerCase(java.util.Locale.ROOT) : names.get(i);
       nc[i] = numChildren.get(i);
       tg[i] = tags.get(i);
     }

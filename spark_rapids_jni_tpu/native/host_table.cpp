@@ -24,6 +24,9 @@ namespace {
 constexpr int32_t kRowAlignment = 8;
 constexpr int64_t kMaxBatchBytes = (1LL << 31) - 1;  // row_conversion.cu:64
 constexpr int64_t kBatchRowMultiple = 32;            // row_conversion.cu:1504
+// Test seam: srjt_debug_set_max_batch_bytes shrinks the limit so the
+// oversized-row failure path is exercisable without 2GB allocations.
+int64_t g_max_batch_bytes = kMaxBatchBytes;
 constexpr int32_t kTypeString = 24;                  // TypeId.STRING (types.py)
 
 inline int64_t round_up(int64_t x, int64_t m) { return (x + m - 1) / m * m; }
@@ -125,14 +128,18 @@ int64_t row_byte_size(const Table& t, const Layout& L, int64_t r) {
 
 // Batch boundaries: scan row sizes, cut before 2GB, boundaries at 32-row
 // multiples except the tail (build_batches, row_conversion.cu:1460-1539).
+// Returns {} when any single row exceeds the batch limit — same contract as
+// the Python engine (layout.build_batches raises ValueError); callers must
+// treat an empty result as a failed conversion.
 std::vector<int64_t> batch_bounds(const Table& t, const Layout& L) {
   std::vector<int64_t> bounds{0};
   int64_t acc = 0, r = 0;
   while (r < t.n_rows) {
     int64_t size = row_byte_size(t, L, r);
-    if (acc + size > kMaxBatchBytes) {
+    if (acc + size > g_max_batch_bytes) {
+      if (acc == 0) return {};  // one row alone blows the limit: fail
       int64_t cut = r - (r % kBatchRowMultiple);
-      if (cut <= bounds.back()) cut = r;  // single huge-row batch guard
+      if (cut <= bounds.back()) cut = r;
       bounds.push_back(cut);
       acc = 0;
       r = cut;
@@ -279,6 +286,10 @@ void* srjt_to_rows(void* table_handle) {
   auto out = new (std::nothrow) RowBatches();
   if (!out) return nullptr;
   auto bounds = batch_bounds(t, L);
+  if (bounds.size() < 2) {  // oversized single row
+    delete out;
+    return nullptr;
+  }
   for (size_t b = 0; b + 1 < bounds.size(); ++b) {
     out->batches.emplace_back();
     pack_rows(t, L, bounds[b], bounds[b + 1], &out->batches.back());
@@ -305,10 +316,23 @@ const int32_t* srjt_rows_batch_offsets(void* h, int32_t b) {
 }
 void srjt_rows_free(void* h) { delete static_cast<RowBatches*>(h); }
 
+// Test-only: shrink the batch byte limit (0 restores the default).
+void srjt_debug_set_max_batch_bytes(int64_t v) {
+  g_max_batch_bytes = v > 0 ? v : kMaxBatchBytes;
+}
+
 // Builds a RowBatches handle around caller-provided row bytes (the
 // convertFromRows input path: Java hands a LIST<INT8> column's buffers).
 void* srjt_rows_import(const uint8_t* data, int64_t data_size,
                        const int32_t* offsets, int64_t n_rows) {
+  // Shuffle-received bytes are untrusted: reject non-monotonic / negative /
+  // out-of-range offsets before they can drive reads or allocations.
+  if (!data || !offsets || n_rows < 0 || data_size < 0) return nullptr;
+  if (offsets[0] != 0) return nullptr;
+  for (int64_t r = 0; r < n_rows; ++r) {
+    if (offsets[r + 1] < offsets[r]) return nullptr;
+  }
+  if (offsets[n_rows] != data_size) return nullptr;
   auto rb = new (std::nothrow) RowBatches();
   if (!rb) return nullptr;
   rb->batches.emplace_back();
@@ -355,11 +379,23 @@ void* srjt_from_rows(void* rows_handle, int32_t batch,
   }
   for (int64_t r = 0; r < n; ++r) {
     const uint8_t* row = B.data.data() + B.offsets[r];
+    int64_t span = B.offsets[r + 1] - B.offsets[r];
+    // Row bytes may be shuffle-received (srjt_rows_import): every row must
+    // cover the fixed+validity area, and string slots must stay in-row.
+    if (span < L.fixed_plus_validity) {
+      delete t;
+      return nullptr;
+    }
     for (int32_t c = 0; c < ncols; ++c) {
       Column& col = *t->cols[c];
       if (col.is_string()) {
         uint32_t slot[2];
         std::memcpy(slot, row + L.starts[c], 8);
+        if (static_cast<int64_t>(slot[0]) + slot[1] > span ||
+            slot[0] < static_cast<uint32_t>(L.fixed_plus_validity)) {
+          delete t;
+          return nullptr;
+        }
         col.offsets[r + 1] =
             col.offsets[r] + static_cast<int32_t>(slot[1]);
       } else {
@@ -369,7 +405,8 @@ void* srjt_from_rows(void* rows_handle, int32_t batch,
       col.valid[r] = (row[L.validity_offset + c / 8] >> (c % 8)) & 1;
     }
   }
-  // phase 2: gather string chars now that offsets are complete
+  // phase 2: gather string chars now that offsets are complete (slots were
+  // bounds-checked in phase 1)
   for (int32_t c = 0; c < ncols; ++c) {
     Column& col = *t->cols[c];
     if (!col.is_string()) continue;

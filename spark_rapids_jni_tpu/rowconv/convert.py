@@ -119,11 +119,24 @@ def _unstage(data: jnp.ndarray, storage: np.dtype) -> jnp.ndarray:
 # fixed-width core: [cols…] → uint8 [n, fixed_row_size]
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnums=0)
 def _to_rows_fixed(layout: RowLayout, datas: tuple[jnp.ndarray, ...],
-                   valid: jnp.ndarray) -> jnp.ndarray:
+                   valid: jnp.ndarray, use_pallas: bool | None = None):
+    """Dispatching wrapper: the Pallas-vs-XLA choice is part of the jit cache
+    key (static arg), so toggling ``SRJT_PALLAS`` at runtime takes effect for
+    shapes that were already traced.  ``None`` reads the env now — callers
+    tracing this inside their own jit inherit trace-time semantics."""
     from . import pallas_kernels
-    if pallas_kernels.fixed_pallas_enabled():
+    if use_pallas is None:
+        use_pallas = pallas_kernels.fixed_pallas_enabled()
+    return _to_rows_fixed_impl(layout, bool(use_pallas), tuple(datas), valid)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _to_rows_fixed_impl(layout: RowLayout, use_pallas: bool,
+                        datas: tuple[jnp.ndarray, ...],
+                        valid: jnp.ndarray) -> jnp.ndarray:
+    if use_pallas:
+        from . import pallas_kernels
         return pallas_kernels.to_rows_fixed(layout, tuple(datas), valid)
     n = valid.shape[0]
     out = jnp.zeros((n, layout.fixed_row_size), dtype=jnp.uint8)
@@ -137,11 +150,22 @@ def _to_rows_fixed(layout: RowLayout, datas: tuple[jnp.ndarray, ...],
     return out
 
 
-@functools.partial(jax.jit, static_argnums=0)
-def _from_rows_fixed(layout: RowLayout, rows: jnp.ndarray):
-    """uint8 [n, fixed_row_size] → (datas tuple, valid bool [n, ncols])."""
+def _from_rows_fixed(layout: RowLayout, rows: jnp.ndarray,
+                     use_pallas: bool | None = None):
+    """uint8 [n, fixed_row_size] → (datas tuple, valid bool [n, ncols]).
+
+    Same dispatch contract as :func:`_to_rows_fixed`."""
     from . import pallas_kernels
-    if pallas_kernels.fixed_pallas_enabled():
+    if use_pallas is None:
+        use_pallas = pallas_kernels.fixed_pallas_enabled()
+    return _from_rows_fixed_impl(layout, bool(use_pallas), rows)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _from_rows_fixed_impl(layout: RowLayout, use_pallas: bool,
+                          rows: jnp.ndarray):
+    if use_pallas:
+        from . import pallas_kernels
         return pallas_kernels.from_rows_fixed(layout, rows)
     datas = []
     for ci, dt in enumerate(layout.schema):
@@ -161,8 +185,9 @@ def _from_rows_fixed(layout: RowLayout, rows: jnp.ndarray):
 # validity-matrix build, byte transpose, offsets arange — is one jit program
 # and the only transfer is the column payloads already resident in HBM.
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
 def _to_rows_fixed_full(layout: RowLayout, has_valid: tuple[bool, ...],
+                        use_pallas: bool,
                         datas: tuple[jnp.ndarray, ...],
                         valids: tuple[jnp.ndarray, ...]):
     """Fixed-width table → (flat row bytes, int32 row offsets), one dispatch.
@@ -175,16 +200,17 @@ def _to_rows_fixed_full(layout: RowLayout, has_valid: tuple[bool, ...],
     cols_valid = [next(vi) if hv else jnp.ones((n,), dtype=jnp.bool_)
                   for hv in has_valid]
     valid = jnp.stack(cols_valid, axis=1)
-    rows2d = _to_rows_fixed(layout, datas, valid)
+    rows2d = _to_rows_fixed(layout, datas, valid, use_pallas)
     offsets = jnp.arange(n + 1, dtype=jnp.int32) * layout.fixed_row_size
     return rows2d.reshape(-1), offsets
 
 
-@functools.partial(jax.jit, static_argnums=0)
-def _from_rows_fixed_full(layout: RowLayout, data: jnp.ndarray):
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _from_rows_fixed_full(layout: RowLayout, use_pallas: bool,
+                          data: jnp.ndarray):
     """Flat row bytes → (datas, per-column validity vectors), one dispatch."""
     rows2d = data.reshape(-1, layout.fixed_row_size)
-    datas, valid = _from_rows_fixed(layout, rows2d)
+    datas, valid = _from_rows_fixed(layout, rows2d, use_pallas)
     valids = tuple(valid[:, ci] for ci in range(layout.num_columns))
     return datas, valids
 
@@ -357,11 +383,14 @@ def convert_to_rows(table: Table,
         boundaries.append(n)
         out = []
         has_valid = tuple(c.validity is not None for c in table.columns)
+        from . import pallas_kernels
+        use_pallas = pallas_kernels.fixed_pallas_enabled()  # outside jit
         for lo, hi in zip(boundaries[:-1], boundaries[1:]):
             cols = (table.columns if (lo, hi) == (0, n)
                     else [_slice_column(c, lo, hi) for c in table.columns])
             data, offsets = _to_rows_fixed_full(
-                layout, has_valid, tuple(_stage(c) for c in cols),
+                layout, has_valid, use_pallas,
+                tuple(_stage(c) for c in cols),
                 tuple(c.validity for c in cols if c.validity is not None))
             out.append(RowBatch(data, offsets))
         return out
@@ -421,7 +450,9 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
             raise ValueError(
                 f"row data holds {batch.data.shape[0]} bytes but offsets "
                 f"describe {n} rows of {layout.fixed_row_size} bytes")
-        datas, valids = _from_rows_fixed_full(layout, batch.data)
+        from . import pallas_kernels
+        datas, valids = _from_rows_fixed_full(
+            layout, pallas_kernels.fixed_pallas_enabled(), batch.data)
         cols = [Column(dt, _unstage(datas[ci], dt.storage), validity=valids[ci])
                 for ci, dt in enumerate(schema)]
         return Table(cols)

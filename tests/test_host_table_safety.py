@@ -1,0 +1,162 @@
+"""Safety/regression tests for the C++ host table engine (host_table.cpp).
+
+Round-1 advisor findings: (a) ``batch_bounds`` infinite-looped when a single
+row exceeded the batch byte limit instead of failing like the Python engine
+(layout.build_batches raises ValueError); (b) ``srjt_rows_import`` /
+``srjt_from_rows`` trusted shuffle-received bytes — offsets and row-embedded
+string slots — without bounds checks, allowing out-of-bounds reads.
+"""
+
+import ctypes as C
+import os
+
+import numpy as np
+import pytest
+
+_LIB = os.path.join(os.path.dirname(__file__), "..",
+                    "spark_rapids_jni_tpu", "native", "libsrjt.so")
+
+if not os.path.exists(_LIB):
+    pytest.skip("libsrjt.so not built", allow_module_level=True)
+
+lib = C.CDLL(_LIB)
+
+lib.srjt_column_fixed.restype = C.c_void_p
+lib.srjt_column_fixed.argtypes = [C.c_int32, C.c_int32, C.c_int64,
+                                  C.c_void_p, C.c_void_p]
+lib.srjt_column_string.restype = C.c_void_p
+lib.srjt_column_string.argtypes = [C.c_int64, C.c_void_p, C.c_void_p,
+                                   C.c_void_p]
+lib.srjt_column_free.argtypes = [C.c_void_p]
+lib.srjt_table.restype = C.c_void_p
+lib.srjt_table.argtypes = [C.c_void_p, C.c_int32]
+lib.srjt_table_free.argtypes = [C.c_void_p]
+lib.srjt_to_rows.restype = C.c_void_p
+lib.srjt_to_rows.argtypes = [C.c_void_p]
+lib.srjt_rows_free.argtypes = [C.c_void_p]
+lib.srjt_rows_import.restype = C.c_void_p
+lib.srjt_rows_import.argtypes = [C.c_void_p, C.c_int64, C.c_void_p,
+                                 C.c_int64]
+lib.srjt_from_rows.restype = C.c_void_p
+lib.srjt_from_rows.argtypes = [C.c_void_p, C.c_int32, C.c_void_p,
+                               C.c_void_p, C.c_int32]
+lib.srjt_table_free.argtypes = [C.c_void_p]
+lib.srjt_debug_set_max_batch_bytes.argtypes = [C.c_int64]
+
+INT32, STRING = 3, 24
+
+
+def _np_ptr(a):
+    return a.ctypes.data_as(C.c_void_p)
+
+
+def _string_table(chars_per_row: int, n: int):
+    """One int32 col + one string col with constant-length strings."""
+    ints = np.arange(n, dtype=np.int32)
+    offs = (np.arange(n + 1, dtype=np.int32) * chars_per_row)
+    chars = np.full(offs[-1], ord("x"), dtype=np.uint8)
+    h_int = lib.srjt_column_fixed(INT32, 0, n, _np_ptr(ints), None)
+    h_str = lib.srjt_column_string(n, _np_ptr(offs), _np_ptr(chars), None)
+    arr = (C.c_void_p * 2)(h_int, h_str)
+    t = lib.srjt_table(arr, 2)
+    lib.srjt_column_free(h_int)
+    lib.srjt_column_free(h_str)
+    return t
+
+
+def test_oversized_row_fails_instead_of_hanging():
+    lib.srjt_debug_set_max_batch_bytes(64)
+    try:
+        t = _string_table(chars_per_row=200, n=4)  # each row > 64B limit
+        rows = lib.srjt_to_rows(t)
+        assert not rows  # nullptr: conversion rejected, not an infinite loop
+        lib.srjt_table_free(t)
+    finally:
+        lib.srjt_debug_set_max_batch_bytes(0)
+
+
+def test_small_limit_still_batches_normal_rows():
+    lib.srjt_debug_set_max_batch_bytes(256)
+    try:
+        t = _string_table(chars_per_row=8, n=64)
+        rows = lib.srjt_to_rows(t)
+        assert rows
+        lib.srjt_rows_free(rows)
+        lib.srjt_table_free(t)
+    finally:
+        lib.srjt_debug_set_max_batch_bytes(0)
+
+
+def _import(data: np.ndarray, offsets: np.ndarray, n: int):
+    return lib.srjt_rows_import(_np_ptr(data), len(data), _np_ptr(offsets), n)
+
+
+def test_import_rejects_bad_offsets():
+    data = np.zeros(64, dtype=np.uint8)
+    # non-monotonic
+    assert not _import(data, np.array([0, 40, 20, 64], dtype=np.int32), 3)
+    # does not start at zero
+    assert not _import(data, np.array([8, 32, 64], dtype=np.int32), 2)
+    # does not end at data_size
+    assert not _import(data, np.array([0, 32, 48], dtype=np.int32), 2)
+    # negative
+    assert not _import(data, np.array([0, -4, 64], dtype=np.int32), 2)
+    # well-formed accepted
+    h = _import(data, np.array([0, 32, 64], dtype=np.int32), 2)
+    assert h
+    lib.srjt_rows_free(h)
+
+
+def _from_rows(rows_handle, type_ids):
+    tids = np.asarray(type_ids, dtype=np.int32)
+    return lib.srjt_from_rows(rows_handle, 0, _np_ptr(tids), None, len(tids))
+
+
+def test_from_rows_rejects_short_rows():
+    # schema int32+string: fixed area = 4(int)+4(pad)+8(slot)+1(validity)->24B
+    data = np.zeros(16, dtype=np.uint8)  # one 16B row: too short
+    h = _import(data, np.array([0, 16], dtype=np.int32), 1)
+    assert h
+    assert not _from_rows(h, [INT32, STRING])
+    lib.srjt_rows_free(h)
+
+
+def test_from_rows_rejects_out_of_row_string_slot():
+    # Build a legitimate row, then corrupt the string slot to point past the
+    # row's end (the shuffle-corruption case): must fail, not read OOB.
+    t = _string_table(chars_per_row=8, n=1)
+    rows = lib.srjt_to_rows(t)
+    assert rows
+    lib.srjt_rows_batch_data.restype = C.POINTER(C.c_uint8)
+    lib.srjt_rows_batch_data.argtypes = [C.c_void_p, C.c_int32]
+    lib.srjt_rows_batch_size.restype = C.c_int64
+    lib.srjt_rows_batch_size.argtypes = [C.c_void_p, C.c_int32]
+    size = lib.srjt_rows_batch_size(rows, 0)
+    buf = np.ctypeslib.as_array(lib.srjt_rows_batch_data(rows, 0),
+                                shape=(size,)).copy()
+    lib.srjt_rows_free(rows)
+    lib.srjt_table_free(t)
+
+    # round-trips clean before corruption
+    offs = np.array([0, size], dtype=np.int32)
+    h = _import(buf, offs, 1)
+    back = _from_rows(h, [INT32, STRING])
+    assert back
+    lib.srjt_table_free(back)
+    lib.srjt_rows_free(h)
+
+    # The string (offset,len) slot lives at bytes 4..12 of the row for this
+    # schema (int32 at 0, slot 4-aligned after it): offset at 4..8, length
+    # at 8..12.  Corrupt the length to something huge:
+    bad = buf.copy()
+    bad[8:12] = np.frombuffer(np.int32(2**31 - 1).tobytes(), dtype=np.uint8)
+    h = _import(bad, offs, 1)
+    assert not _from_rows(h, [INT32, STRING])
+    lib.srjt_rows_free(h)
+
+    # corrupt the slot offset to point before the fixed area
+    bad2 = buf.copy()
+    bad2[4:8] = np.frombuffer(np.int32(2).tobytes(), dtype=np.uint8)
+    h = _import(bad2, offs, 1)
+    assert not _from_rows(h, [INT32, STRING])
+    lib.srjt_rows_free(h)

@@ -199,3 +199,32 @@ def test_zero_row_roundtrip():
     batches = convert_to_rows(ts)
     back = convert_from_rows(batches[0], ts.schema)
     assert back.num_rows == 0
+
+
+def test_pallas_toggle_not_baked_into_jit_cache(monkeypatch):
+    # Round-1 advisor finding: the Pallas-vs-XLA choice was read at trace
+    # time inside the jitted cores, so flipping SRJT_PALLAS had no effect on
+    # shapes already traced.  The choice is now a static jit argument read
+    # per call: with the same shapes, a flipped decision must reach the
+    # Pallas entry point.
+    from spark_rapids_jni_tpu.rowconv import convert as cv
+    from spark_rapids_jni_tpu.rowconv import pallas_kernels as pk
+
+    t = Table([Column.from_numpy(np.arange(64, dtype=np.int32))])
+
+    monkeypatch.setattr(pk, "fixed_pallas_enabled", lambda: False)
+    convert_to_rows(t)  # traces the XLA variant for these shapes
+
+    seen = {}
+
+    def sentinel(layout, datas, valid):
+        seen["hit"] = True
+        raise RuntimeError("pallas sentinel")
+
+    monkeypatch.setattr(pk, "fixed_pallas_enabled", lambda: True)
+    monkeypatch.setattr(pk, "to_rows_fixed", sentinel)
+    try:
+        convert_to_rows(t)
+    except Exception:
+        pass
+    assert seen.get("hit"), "flipped dispatch never reached the Pallas path"
