@@ -102,18 +102,46 @@ def build_table(n_rows: int) -> Table:
 
 
 def time_device(table: Table) -> tuple[float, int]:
-    def roundtrip():
-        batches = convert_to_rows(table)
-        for batch in batches:  # decode every batch so bytes match the timing
-            back = convert_from_rows(batch, table.schema)
-            jax.block_until_ready([c.data for c in back.columns])
-        return sum(b.num_bytes for b in batches)
+    """In-jit chained-loop timing with one forced materialization.
 
-    for _ in range(WARMUP):
-        total_bytes = roundtrip()
+    Two facts about the axon-tunneled v5e dictate the shape of this timer
+    (round-1's 106-208 GB/s figure predates both and was a dispatch-rate
+    artifact, not throughput):
+
+    * ``jax.block_until_ready`` is NOT a sync — execution defers until bytes
+      are requested, so the timed window must end with a real (tiny) D2H;
+    * every dispatch costs ~12 ms and every sync ~65-110 ms through the
+      tunnel, so the ITERS round trips run inside ONE jitted ``fori_loop``
+      (the public conversion API is jit-traceable for fixed-width schemas),
+      dependency-chained so the device cannot elide iterations.
+    """
+    import jax.numpy as jnp
+    from spark_rapids_jni_tpu.column import Column, Table as _Table
+
+    batches0 = convert_to_rows(table)
+    total_bytes = sum(b.num_bytes for b in batches0)
+
+    @jax.jit
+    def loop(table):
+        def body(_, carry):
+            cols = list(table.columns)
+            c0 = cols[0]
+            cols[0] = Column(c0.dtype,
+                             jax.lax.optimization_barrier(
+                                 (c0.data, carry))[0],
+                             c0.offsets, c0.validity)
+            acc = jnp.zeros((), jnp.int32)
+            for batch in convert_to_rows(_Table(cols)):
+                back = convert_from_rows(batch, table.schema)
+                for c in back.columns:
+                    acc = acc + jax.lax.convert_element_type(
+                        jnp.ravel(c.data)[0], jnp.int32)
+            return acc % jnp.int32(251)
+        return jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+    np.asarray(loop(table))   # compile + warm
     t0 = time.perf_counter()
-    for _ in range(ITERS):
-        total_bytes = roundtrip()
+    np.asarray(loop(table))   # one dispatch, one real sync
     dt = (time.perf_counter() - t0) / ITERS
     return dt, total_bytes
 

@@ -17,6 +17,50 @@ import time
 from typing import Callable, Mapping, Sequence
 
 import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sync(tree) -> None:
+    """Force device execution to complete.
+
+    ``jax.block_until_ready`` is NOT sufficient on remote-dispatch backends
+    (observed on the axon-tunneled v5e: execution is deferred until bytes are
+    requested, so block_until_ready returns immediately and timings measure
+    dispatch rate, not device throughput).  Materializing one element of
+    every output leaf forces the computation.
+    """
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "shape") and getattr(leaf, "size", 1):
+            np.asarray(jax.numpy.ravel(leaf)[0])
+
+
+def chain_carry(tree) -> jnp.ndarray:
+    """A cheap scalar data-dependent on every leaf of ``tree``.
+
+    Feeding this into the next timed iteration chains the iterations so that
+    one final :func:`sync` provably executes them all (a lazy backend would
+    otherwise skip unmaterialized intermediate calls entirely).
+    """
+    acc = jnp.zeros((), jnp.int32)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "shape") and getattr(leaf, "size", 1):
+            acc = acc + jax.lax.convert_element_type(
+                jnp.ravel(leaf)[0], jnp.int32)
+    # bounded but NOT statically foldable (x % 1 would simplify to 0 and
+    # sever the chain)
+    return acc % jnp.int32(251)
+
+
+@jax.jit
+def tie(x, carry):
+    """Return ``x`` unchanged but data-dependent on ``carry``.
+
+    ``lax.optimization_barrier`` is opaque to XLA's simplifier, so the
+    dependency survives without perturbing values — closures use this to
+    chain their inputs to the previous iteration's outputs.
+    """
+    return jax.lax.optimization_barrier((x, carry))[0]
 
 
 @dataclasses.dataclass
@@ -39,15 +83,19 @@ class Result:
 
 
 class Bench:
-    def __init__(self, name: str, fn: Callable[[State], Callable[[], object]],
+    def __init__(self, name: str, fn: Callable[[State], Callable[..., object]],
                  axes: Mapping[str, Sequence[object]],
                  skip: Callable[[State], str | None] = lambda s: None):
         """``fn(state)`` prepares inputs and returns the timed closure.
 
-        The closure must leave device work complete (the harness wraps it in
-        ``jax.block_until_ready`` on whatever it returns).  ``skip`` may
-        return a reason string (the reference skips >1M-row string states,
-        ``benchmarks/row_conversion.cpp:117-120``).
+        The closure takes one argument — a scalar ``carry`` it must fold into
+        its device inputs (e.g. add to one input column) — and returns its
+        device outputs.  The harness chains iterations through the carry and
+        forces execution once at the end (:func:`sync`), so the measured
+        window is device time, amortizing the per-sync round-trip latency
+        (~65-110 ms through the axon tunnel) across all iterations.  ``skip``
+        may return a reason string (the reference skips >1M-row string
+        states, ``benchmarks/row_conversion.cpp:117-120``).
         """
         self.name, self.fn, self.axes, self.skip = name, fn, axes, skip
 
@@ -65,11 +113,15 @@ class Bench:
                 print(f"  SKIP {self.name}[{tag}]: {reason}", flush=True)
                 continue
             closure = self.fn(state)
+            carry = jnp.zeros((), jnp.int32)
             for _ in range(warmup):
-                jax.block_until_ready(closure())
+                carry = chain_carry(closure(carry))
+            sync(carry)
             t0 = time.perf_counter()
+            carry = jnp.zeros((), jnp.int32)
             for _ in range(iters):
-                jax.block_until_ready(closure())
+                carry = chain_carry(closure(carry))
+            sync(carry)
             dt = (time.perf_counter() - t0) / iters
             gbps = state.bytes_per_iter / dt / 1e9 if state.bytes_per_iter else 0.0
             results.append(Result(self.name, dict(state.params), dt, gbps))

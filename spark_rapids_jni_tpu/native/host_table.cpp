@@ -386,18 +386,29 @@ void* srjt_from_rows(void* rows_handle, int32_t batch,
       delete t;
       return nullptr;
     }
+    // JCUDF packs all variable-width chars contiguously after the validity
+    // bytes, in column order; enforcing that exact invariant (not just
+    // per-slot in-row bounds) rejects overlapping slots, which would
+    // otherwise let one crafted row claim its full tail for EVERY string
+    // column and amplify the phase-2 allocation ncols-fold.
+    int64_t chars_cursor = L.fixed_plus_validity;
     for (int32_t c = 0; c < ncols; ++c) {
       Column& col = *t->cols[c];
       if (col.is_string()) {
         uint32_t slot[2];
         std::memcpy(slot, row + L.starts[c], 8);
-        if (static_cast<int64_t>(slot[0]) + slot[1] > span ||
-            slot[0] < static_cast<uint32_t>(L.fixed_plus_validity)) {
+        if (slot[0] != chars_cursor ||
+            static_cast<int64_t>(slot[0]) + slot[1] > span) {
           delete t;
           return nullptr;
         }
-        col.offsets[r + 1] =
-            col.offsets[r] + static_cast<int32_t>(slot[1]);
+        chars_cursor += slot[1];
+        int64_t next = static_cast<int64_t>(col.offsets[r]) + slot[1];
+        if (next > INT32_MAX) {  // offsets are int32 (2GB column contract)
+          delete t;
+          return nullptr;
+        }
+        col.offsets[r + 1] = static_cast<int32_t>(next);
       } else {
         std::memcpy(col.data.data() + r * L.sizes[c], row + L.starts[c],
                     L.sizes[c]);

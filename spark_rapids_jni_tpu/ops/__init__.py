@@ -1,4 +1,5 @@
 from . import hashing  # noqa: F401
+from . import strings  # noqa: F401
 from .cast import cast  # noqa: F401
 from .filter import apply_boolean_mask, gather, mask_table  # noqa: F401
 from .groupby import groupby_aggregate  # noqa: F401

@@ -77,10 +77,18 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
     n = table.num_rows
     if n == 0:
         raise ValueError("groupby of an empty table")
+    # string keys: swap in order-preserving dictionary codes (ops.strings) so
+    # ordering/segmenting below see plain int32 lanes; the output key columns
+    # are decoded from the dictionary at the end
+    str_dicts: dict[int, Column] = {}
+    work_cols = list(table.columns)
     for ki in key_indices:
         if table[ki].dtype.is_variable_width:
-            raise NotImplementedError(
-                "string group keys: dictionary-encode first (ops.strings)")
+            from . import strings
+            codes, uniq = strings.dictionary_encode(table[ki])
+            work_cols[ki] = codes
+            str_dicts[ki] = uniq
+    table = Table(work_cols)
     order = order_by(table, list(key_indices))
     sorted_tbl = gather(table, order)
 
@@ -92,7 +100,17 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
     # one representative row per segment for the key columns
     head_pos = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int32), seg_ids,
                                    num_segments)
-    out_cols = [_take_rows(sorted_tbl[ki], head_pos) for ki in key_indices]
+    out_cols = []
+    for ki in key_indices:
+        head = _take_rows(sorted_tbl[ki], head_pos)
+        if ki in str_dicts:
+            # decode: the code IS the dictionary row index
+            from .filter import _gather_column
+            dec = _gather_column(str_dicts[ki], head.data)
+            out_cols.append(Column(dec.dtype, dec.data, dec.offsets,
+                                   head.validity))
+        else:
+            out_cols.append(head)
 
     for vi, agg in aggs:
         col = sorted_tbl[vi]

@@ -39,6 +39,11 @@ def join_indices(left: Column, right: Column,
     ``semi``/``anti`` return only left_idx.  ``left`` outer marks unmatched
     rows with right_idx == -1 (callers null-fill on gather).
     """
+    if left.dtype.is_variable_width or right.dtype.is_variable_width:
+        # string keys: one shared dictionary makes code equality == string
+        # equality across both sides (ops.strings)
+        from . import strings
+        left, right = strings.encode_shared([left, right])
     ldata, lvalid = _key_with_nulls_last(left)
     rdata, rvalid = _key_with_nulls_last(right)
 

@@ -26,19 +26,22 @@ def order_by(table: Table, keys: Sequence[int],
     # lexsort sorts by the LAST key first → feed keys in reverse priority
     for ki, asc, nf in reversed(list(zip(keys, ascending, nulls_first))):
         col = table[ki]
-        data = col.data
         if col.dtype.id.name == "STRING":
-            raise NotImplementedError("string sort keys: ops.strings")
-        if not asc:
-            data = -data if data.dtype.kind == "f" else ~data  # order-reversing
+            # u32 byte lanes + length tiebreak (see ops.strings), already in
+            # increasing-priority order for lexsort
+            from . import strings
+            key_lanes = strings.sort_key_lanes(col, descending=not asc)
+        else:
+            data = col.data
+            if not asc:
+                data = -data if data.dtype.kind == "f" else ~data  # order-reversing
+            key_lanes = [data]
+        lanes.extend(key_lanes)
         if col.validity is not None:
             # the rank lane always sorts ascending, independent of the data
             # lane's direction: 0 → nulls first, 2 → nulls last
             null_rank = jnp.where(col.validity, 1, 0 if nf else 2)
-            lanes.append(data)
             lanes.append(null_rank)   # appended after → higher priority
-        else:
-            lanes.append(data)
     return jnp.lexsort(tuple(lanes))
 
 

@@ -1,0 +1,269 @@
+"""String operations — the libcudf ``strings`` slice the Spark plugin needs
+(SURVEY §2.9; the reference gets all of this from the cudf submodule, e.g.
+``make_strings_column`` usage at ``row_conversion.cu:2240``).
+
+TPU-first design.  A STRING column is Arrow layout (uint8 chars [total] +
+int32 offsets [n+1]) — variable-width data the VPU cannot compare directly.
+The central primitive here is the **padded byte matrix**: a [n, L] uint8 view
+(L = max length, resolved with one host sync — the same two-phase shape
+discipline as the row-conversion strings path), packed big-endian into u32
+lanes so that *numeric* lane comparison equals *lexicographic byte*
+comparison.  Everything else rides on that:
+
+* ``sort_key_lanes`` — lanes for ``jnp.lexsort`` (unlocks string sort keys);
+* ``dictionary_encode`` — order-preserving dense int32 codes + dictionary
+  (sort → adjacent-unique → rank), the enabler for string groupby keys;
+* ``encode_shared`` — one dictionary across several columns, so equi-joins
+  can compare codes instead of bytes;
+* ``equal_to`` / ``equal_to_scalar`` — vectorized equality;
+* ``upper`` / ``lower`` / ``substring`` / ``concat`` — the elementwise
+  minimum for TPC-DS-shaped plans.
+
+Null semantics follow Spark: null compares as null (predicates yield False),
+nulls form their own group key, and null join keys never match.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..column import Column
+from ..rowconv.convert import _segment_of  # marker-scatter + cumsum lookup
+
+
+def _lengths(col: Column) -> jnp.ndarray:
+    return col.offsets[1:] - col.offsets[:-1]
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def byte_matrix(col: Column, width: Optional[int] = None):
+    """Padded byte view: (uint8 [n, L], lengths int32 [n]).
+
+    ``mat[i, j]`` is the j-th byte of row i, zero beyond the row's length.
+    ``width`` pins L (callers comparing two columns share the larger);
+    otherwise L = max row length, one host sync, rounded up to 4.
+    """
+    n = col.num_rows
+    lens = _lengths(col)
+    if width is None:
+        width = int(jnp.max(lens)) if n else 0
+    L = max(_round_up(width, 4), 4)
+    j = jnp.arange(L, dtype=jnp.int32)
+    idx = col.offsets[:-1, None] + j[None, :]
+    mask = j[None, :] < lens[:, None]
+    if col.data.shape[0]:
+        mat = jnp.where(mask, col.data[jnp.clip(idx, 0, col.data.shape[0] - 1)],
+                        jnp.uint8(0))
+    else:
+        mat = jnp.zeros((n, L), dtype=jnp.uint8)
+    return mat, lens
+
+
+def _u32_lanes(mat: jnp.ndarray) -> jnp.ndarray:
+    """[n, L] bytes → [n, L//4] big-endian u32 lanes (lane compare ==
+    lexicographic byte compare)."""
+    n, L = mat.shape
+    b = mat.reshape(n, L // 4, 4).astype(jnp.uint32)
+    return (b[..., 0] << 24) | (b[..., 1] << 16) | (b[..., 2] << 8) | b[..., 3]
+
+
+def sort_key_lanes(col: Column, descending: bool = False) -> list[jnp.ndarray]:
+    """Lanes for ``jnp.lexsort``, in *increasing* priority order (the caller
+    appends them in this order; lexsort treats later keys as higher priority).
+
+    Priority within one string key: first 4 bytes > next 4 bytes > … >
+    length (the tiebreak that orders a string after its proper prefix —
+    zero padding alone cannot distinguish "ab" from "ab\\x00")."""
+    mat, lens = byte_matrix(col)
+    lanes = _u32_lanes(mat)
+    out = [(-lens if descending else lens)]
+    for k in range(lanes.shape[1] - 1, -1, -1):
+        lane = lanes[:, k]
+        out.append(~lane if descending else lane)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dictionary encode
+# ---------------------------------------------------------------------------
+
+def dictionary_encode(col: Column) -> tuple[Column, Column]:
+    """Order-preserving dense codes: (codes int32 column, dictionary column).
+
+    ``codes[i]`` is the rank of row i's string among the distinct strings
+    (so code comparison == string comparison), and indexes the returned
+    dictionary column directly.  Null rows encode as the zeroed byte string
+    (one shared code) with validity carried through — equality on
+    (code, validity) pairs equals Spark's null-aware key equality.
+    """
+    n = col.num_rows
+    if n == 0:
+        return (Column(T.int32, jnp.zeros(0, jnp.int32)),
+                Column(T.string, jnp.zeros(0, jnp.uint8),
+                       jnp.zeros(1, jnp.int32)))
+    mat, lens = byte_matrix(col)
+    if col.validity is not None:
+        # nulls collapse onto the zeroed key so they share one code
+        mat = jnp.where(col.validity[:, None], mat, jnp.uint8(0))
+        lens = jnp.where(col.validity, lens, 0)
+    lanes = _u32_lanes(mat)
+
+    sort_keys = [lens] + [lanes[:, k] for k in range(lanes.shape[1] - 1, -1, -1)]
+    order = jnp.lexsort(tuple(sort_keys))
+
+    s_lanes = lanes[order]
+    s_lens = lens[order]
+    head = jnp.zeros(n, dtype=jnp.int32)
+    neq = jnp.any(s_lanes[1:] != s_lanes[:-1], axis=1) | (s_lens[1:] != s_lens[:-1])
+    head = head.at[1:].set(neq.astype(jnp.int32))
+    codes_sorted = jnp.cumsum(head, dtype=jnp.int32)
+
+    codes = jnp.zeros(n, dtype=jnp.int32).at[order].set(codes_sorted)
+
+    # dictionary: one representative row per distinct value, gathered from
+    # the ORIGINAL column.  Null rows share code 0 with the zeroed key but
+    # still carry their original bytes, so a valid row must win the
+    # representative slot wherever one exists (otherwise a masked-null row's
+    # payload could decode as the empty-string group key): scatter any row
+    # first, then overwrite with valid rows (invalid ones routed to a trash
+    # slot).
+    ndict = int(codes_sorted[-1]) + 1          # scalar sync (distinct count)
+    order32 = order.astype(jnp.int32)
+    first_pos = jnp.zeros(ndict + 1, dtype=jnp.int32).at[
+        jnp.flip(codes_sorted)].set(jnp.flip(order32))
+    if col.validity is not None:
+        slot = jnp.where(col.validity[order], codes_sorted, ndict)
+        first_pos = first_pos.at[jnp.flip(slot)].set(jnp.flip(order32))
+    from .filter import _gather_column
+    uniq = _gather_column(Column(col.dtype, col.data, col.offsets),
+                          first_pos[:ndict])
+    return Column(T.int32, codes, validity=col.validity), uniq
+
+
+def encode_shared(cols: Sequence[Column]) -> list[Column]:
+    """Encode several string columns against ONE shared dictionary, so codes
+    compare/equate across columns (the equi-join enabler)."""
+    sizes = [c.num_rows for c in cols]
+    chars = jnp.concatenate([c.data for c in cols]) if any(
+        c.data.shape[0] for c in cols) else jnp.zeros(0, jnp.uint8)
+    offs_parts, validity_parts = [jnp.zeros(1, jnp.int32)], []
+    char_base = 0
+    for c in cols:
+        offs_parts.append(c.offsets[1:] + char_base)
+        char_base += int(c.data.shape[0])
+        validity_parts.append(c.validity_or_true())
+    combined = Column(
+        T.string, chars, jnp.concatenate(offs_parts),
+        None if all(c.validity is None for c in cols)
+        else jnp.concatenate(validity_parts))
+    codes, _ = dictionary_encode(combined)
+    out, base = [], 0
+    for c, sz in zip(cols, sizes):
+        out.append(Column(T.int32, codes.data[base:base + sz],
+                          validity=c.validity))
+        base += sz
+    return out
+
+
+# ---------------------------------------------------------------------------
+# equality
+# ---------------------------------------------------------------------------
+
+def equal_to(a: Column, b: Column) -> Column:
+    """Row-wise string equality → BOOL8 column (null if either side null)."""
+    la, lb = _lengths(a), _lengths(b)
+    width = int(jnp.maximum(jnp.max(la) if a.num_rows else 0,
+                            jnp.max(lb) if b.num_rows else 0))
+    ma, _ = byte_matrix(a, width)
+    mb, _ = byte_matrix(b, width)
+    eq = (la == lb) & jnp.all(ma == mb, axis=1)
+    v = None
+    if a.validity is not None or b.validity is not None:
+        v = a.validity_or_true() & b.validity_or_true()
+    return Column(T.bool8, eq.astype(jnp.uint8), validity=v)
+
+
+def equal_to_scalar(col: Column, value: str | bytes) -> Column:
+    """Column == scalar → BOOL8 column (null rows stay null)."""
+    payload = value.encode("utf-8") if isinstance(value, str) else bytes(value)
+    lens = _lengths(col)
+    mat, _ = byte_matrix(col, max(len(payload), 1))
+    target = np.zeros(mat.shape[1], dtype=np.uint8)
+    target[:len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    eq = (lens == len(payload)) & jnp.all(mat == jnp.asarray(target)[None, :],
+                                          axis=1)
+    return Column(T.bool8, eq.astype(jnp.uint8), validity=col.validity)
+
+
+# ---------------------------------------------------------------------------
+# elementwise transforms
+# ---------------------------------------------------------------------------
+
+def upper(col: Column) -> Column:
+    """ASCII uppercase (the reference's unicode_to_lower analog operates
+    ASCII-per-byte for pruning too, NativeParquetJni.cpp:45)."""
+    c = col.data
+    is_lower = (c >= 97) & (c <= 122)
+    return Column(T.string, jnp.where(is_lower, c - 32, c), col.offsets,
+                  col.validity)
+
+
+def lower(col: Column) -> Column:
+    """ASCII lowercase."""
+    c = col.data
+    is_upper = (c >= 65) & (c <= 90)
+    return Column(T.string, jnp.where(is_upper, c + 32, c), col.offsets,
+                  col.validity)
+
+
+def substring(col: Column, start: int, length: Optional[int] = None) -> Column:
+    """0-based byte substring [start, start+length) of every row."""
+    if start < 0:
+        raise ValueError("substring start must be >= 0")
+    lens = _lengths(col)
+    new_lens = jnp.maximum(lens - start, 0)
+    if length is not None:
+        new_lens = jnp.minimum(new_lens, length)
+    new_offs = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(new_lens, dtype=jnp.int32)])
+    total = int(new_offs[-1])                  # scalar sync (chars total)
+    if total == 0:
+        return Column(T.string, jnp.zeros(0, jnp.uint8), new_offs, col.validity)
+    row_of = _segment_of(new_offs, total)
+    within = jnp.arange(total, dtype=jnp.int32) - new_offs[row_of]
+    src = col.offsets[:-1][row_of] + start + within
+    return Column(T.string, col.data[src], new_offs, col.validity)
+
+
+def concat(a: Column, b: Column) -> Column:
+    """Row-wise concatenation a[i] + b[i] (null if either side null — Spark
+    ``concat`` semantics)."""
+    la, lb = _lengths(a), _lengths(b)
+    valid = None
+    if a.validity is not None or b.validity is not None:
+        valid = a.validity_or_true() & b.validity_or_true()
+        la = jnp.where(valid, la, 0)
+        lb = jnp.where(valid, lb, 0)
+    new_lens = la + lb
+    new_offs = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(new_lens, dtype=jnp.int32)])
+    total = int(new_offs[-1])                  # scalar sync (chars total)
+    if total == 0:
+        return Column(T.string, jnp.zeros(0, jnp.uint8), new_offs, valid)
+    row_of = _segment_of(new_offs, total)
+    within = jnp.arange(total, dtype=jnp.int32) - new_offs[row_of]
+    from_a = within < la[row_of]
+    src_a = a.offsets[:-1][row_of] + within
+    src_b = b.offsets[:-1][row_of] + (within - la[row_of])
+    ca = (a.data[jnp.clip(src_a, 0, a.data.shape[0] - 1)]
+          if a.data.shape[0] else jnp.zeros_like(row_of, dtype=jnp.uint8))
+    cb = (b.data[jnp.clip(src_b, 0, b.data.shape[0] - 1)]
+          if b.data.shape[0] else jnp.zeros_like(row_of, dtype=jnp.uint8))
+    return Column(T.string, jnp.where(from_a, ca, cb), new_offs, valid)

@@ -128,6 +128,7 @@ def _to_rows_fixed(layout: RowLayout, datas: tuple[jnp.ndarray, ...],
     from . import pallas_kernels
     if use_pallas is None:
         use_pallas = pallas_kernels.fixed_pallas_enabled()
+    use_pallas = use_pallas and pallas_kernels.layout_supported(layout)
     return _to_rows_fixed_impl(layout, bool(use_pallas), tuple(datas), valid)
 
 
@@ -158,6 +159,7 @@ def _from_rows_fixed(layout: RowLayout, rows: jnp.ndarray,
     from . import pallas_kernels
     if use_pallas is None:
         use_pallas = pallas_kernels.fixed_pallas_enabled()
+    use_pallas = use_pallas and pallas_kernels.layout_supported(layout)
     return _from_rows_fixed_impl(layout, bool(use_pallas), rows)
 
 
@@ -219,58 +221,124 @@ def _from_rows_fixed_full(layout: RowLayout, use_pallas: bool,
 # variable-width core (strings): statically-shaped scatter/gather
 # ---------------------------------------------------------------------------
 
+def _segment_of(starts: jnp.ndarray, total: int) -> jnp.ndarray:
+    """For each position in [0, total): the index of the sorted segment
+    containing it.  ``starts`` is int32 [S+1] inclusive starts with a final
+    sentinel == total.
+
+    One tiny scatter-add (S markers) + one cumsum — the TPU-friendly
+    replacement for a per-position binary search.  Empty segments (repeated
+    starts) accumulate multiple increments at one position, so positions
+    correctly skip past them.
+    """
+    markers = jnp.zeros((total,), dtype=jnp.int32).at[starts[1:-1]].add(1)
+    return jnp.cumsum(markers)
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def _to_rows_var(layout: RowLayout, total_bytes: int,
                  datas: tuple[jnp.ndarray, ...],
                  str_offsets: tuple[jnp.ndarray, ...],
                  valid: jnp.ndarray,
                  row_offsets: jnp.ndarray) -> jnp.ndarray:
-    """Strings path: scatter fixed slots, (offset,len) pairs, validity and
-    chars into one flat byte buffer (``copy_strings_to_rows`` semantics,
-    row_conversion.cu:852-874)."""
+    """Strings path: one gather pass over the output bytes.
+
+    The reference's ``copy_to_rows``/``copy_strings_to_rows`` kernels
+    (row_conversion.cu:575-693, 827-875) scatter from columns into rows; a
+    scatter on TPU serializes, so this inverts the direction — every output
+    byte *gathers* its source:
+
+    1. the fixed region (column slots, string (offset,len) slots, validity) is
+       built as a dense [n, fixed_plus_validity] matrix with vectorized
+       column-slice writes;
+    2. each flat output position finds its row via a marker-cumsum (no binary
+       search), then either reads the fixed matrix or computes the (column,
+       char) source for the string tail and reads the concatenated chars
+       buffer.
+
+    All heavy traffic is gathers + cumsums; the only scatters are the tiny
+    segment-start markers.  The final assembly runs in fixed-size blocks
+    (``lax.map``) so the [total_bytes]-sized int32 index temporaries never
+    coexist — at 155-column/1M-row scale the unblocked formulation OOMs HBM.
+    """
     n = valid.shape[0]
-    row_base = row_offsets[:-1].astype(jnp.int64)          # [n]
-    out = jnp.zeros((total_bytes,), dtype=jnp.uint8)
+    var_idx = layout.variable_column_indices
+    nvar = len(var_idx)
+    fpv = layout.fixed_plus_validity
+    if n == 0 or total_bytes == 0:
+        return jnp.zeros((total_bytes,), dtype=jnp.uint8)
+    row_offsets = row_offsets.astype(jnp.int32)             # batch ≤ 2^31-1
+    row_base = row_offsets[:-1]                             # [n]
 
     # per-row, per-variable-column char lengths and exclusive prefix
-    var_idx = layout.variable_column_indices
     lens = jnp.stack(
-        [str_offsets[vi][1:] - str_offsets[vi][:-1] for vi in range(len(var_idx))],
-        axis=1).astype(jnp.int64)                           # [n, nvar]
+        [str_offsets[vi][1:] - str_offsets[vi][:-1] for vi in range(nvar)],
+        axis=1).astype(jnp.int32)                           # [n, nvar]
     prefix = jnp.cumsum(lens, axis=1) - lens                # exclusive, [n, nvar]
+    row_lens = prefix[:, -1] + lens[:, -1]                  # chars per row [n]
+    row_char_prefix = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(row_lens, dtype=jnp.int32)])            # [n+1]
 
+    # dense fixed-region matrix [n, fpv]
+    fixed2d = jnp.zeros((n, fpv), dtype=jnp.uint8)
     vi_of_ci = {ci: vi for vi, ci in enumerate(var_idx)}
     for ci, dt in enumerate(layout.schema):
         start = layout.column_starts[ci]
         if dt.is_variable_width:
             vi = vi_of_ci[ci]
-            slot_off = (layout.fixed_plus_validity + prefix[:, vi]).astype(jnp.uint32)
+            slot_off = (fpv + prefix[:, vi]).astype(jnp.uint32)
             slot = jnp.stack([slot_off, lens[:, vi].astype(jnp.uint32)], axis=1)
             b = jax.lax.bitcast_convert_type(slot, jnp.uint8).reshape(n, 8)
         else:
             b = _byte_view(datas[ci], dt.storage)
-        pos = row_base[:, None] + start + jnp.arange(b.shape[1])[None, :]
-        out = out.at[pos.reshape(-1)].set(b.reshape(-1))
-
-    # validity bytes
+        fixed2d = fixed2d.at[:, start:start + b.shape[1]].set(b)
     vbytes = bitmask.pack_bool_matrix(valid)
-    pos = (row_base[:, None] + layout.validity_offset
-           + jnp.arange(layout.validity_bytes)[None, :])
-    out = out.at[pos.reshape(-1)].set(vbytes.reshape(-1))
+    fixed2d = fixed2d.at[:, layout.validity_offset:
+                         layout.validity_offset + layout.validity_bytes].set(vbytes)
 
-    # chars: for each variable column, scatter its flat chars buffer
-    for vi, ci in enumerate(var_idx):
-        chars = datas[ci]
-        total_chars = chars.shape[0]
-        if total_chars == 0:
-            continue
-        offs = str_offsets[vi].astype(jnp.int64)
-        char_ids = jnp.arange(total_chars, dtype=jnp.int64)
-        row_of = jnp.searchsorted(offs, char_ids, side="right") - 1
-        dest = (row_base[row_of] + layout.fixed_plus_validity
-                + prefix[row_of, vi] + (char_ids - offs[row_of]))
-        out = out.at[dest].set(chars)
-    return out
+    # interleaved chars buffer, ordered (row, var-col) — one segment per
+    # (row, col) pair, located with a single segment-cumsum
+    total_chars = int(sum(datas[ci].shape[0] for ci in var_idx))
+    if total_chars:
+        chars_concat = jnp.concatenate([datas[ci] for ci in var_idx])
+        col_bases = jnp.asarray(np.concatenate(
+            [[0], np.cumsum([datas[ci].shape[0] for ci in var_idx])]
+        ).astype(np.int32))
+        seg_start = jnp.concatenate([
+            (row_char_prefix[:-1, None] + prefix).reshape(-1),
+            jnp.full((1,), total_chars, jnp.int32)])        # [n*nvar + 1]
+        seg_of = _segment_of(seg_start, total_chars)
+        offs_at = jnp.stack([str_offsets[vi][:-1].astype(jnp.int32)
+                             for vi in range(nvar)], axis=1).reshape(-1)
+        q = jnp.arange(total_chars, dtype=jnp.int32)
+        src = (col_bases[seg_of % nvar] + offs_at[seg_of]
+               + (q - seg_start[seg_of]))
+        ichars = chars_concat[src]
+    else:
+        ichars = jnp.zeros((1,), dtype=jnp.uint8)           # safe dummy gather
+
+    row_of_all = _segment_of(row_offsets, total_bytes)      # [total_bytes]
+    fixed_flat = fixed2d.reshape(-1)
+
+    block = 1 << 22
+    nblocks = -(-total_bytes // block)
+    row_of_pad = jnp.pad(row_of_all, (0, nblocks * block - total_bytes))
+
+    def assemble(b):
+        o = b * block + jnp.arange(block, dtype=jnp.int32)
+        ro = jax.lax.dynamic_slice(row_of_pad, (b * block,), (block,))
+        w = o - row_base[ro]                                # offset within row
+        in_fixed = w < fpv
+        fval = fixed_flat[ro * fpv + jnp.clip(w, 0, fpv - 1)]
+        u = jnp.maximum(w - fpv, 0)                         # char idx in row
+        in_chars = (~in_fixed) & (u < row_lens[ro])         # excludes padding
+        cidx = jnp.clip(row_char_prefix[ro] + u, 0, max(total_chars - 1, 0))
+        return jnp.where(in_fixed, fval,
+                         jnp.where(in_chars, ichars[cidx], jnp.uint8(0)))
+
+    out = jax.lax.map(assemble, jnp.arange(nblocks, dtype=jnp.int32))
+    return out.reshape(-1)[:total_bytes]
 
 
 @functools.partial(jax.jit, static_argnums=0)
@@ -325,8 +393,10 @@ def _from_rows_var(layout: RowLayout, char_totals: tuple[int, ...],
         if total == 0:
             chars_out.append(jnp.zeros((0,), dtype=jnp.uint8))
             continue
+        # marker-cumsum segment lookup (see _segment_of) instead of a
+        # per-char binary search
+        row_of = _segment_of(offs.astype(jnp.int32), total)
         char_ids = jnp.arange(total, dtype=jnp.int64)
-        row_of = jnp.searchsorted(offs, char_ids, side="right") - 1
         src = src_base[row_of] + (char_ids - offs[row_of])
         chars_out.append(data[src])
     return tuple(datas), valid, tuple(chars_out)
@@ -384,7 +454,8 @@ def convert_to_rows(table: Table,
         out = []
         has_valid = tuple(c.validity is not None for c in table.columns)
         from . import pallas_kernels
-        use_pallas = pallas_kernels.fixed_pallas_enabled()  # outside jit
+        use_pallas = (pallas_kernels.fixed_pallas_enabled()  # outside jit
+                      and pallas_kernels.layout_supported(layout))
         for lo, hi in zip(boundaries[:-1], boundaries[1:]):
             cols = (table.columns if (lo, hi) == (0, n)
                     else [_slice_column(c, lo, hi) for c in table.columns])
@@ -452,7 +523,10 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
                 f"describe {n} rows of {layout.fixed_row_size} bytes")
         from . import pallas_kernels
         datas, valids = _from_rows_fixed_full(
-            layout, pallas_kernels.fixed_pallas_enabled(), batch.data)
+            layout,
+            (pallas_kernels.fixed_pallas_enabled()
+             and pallas_kernels.layout_supported(layout)),
+            batch.data)
         cols = [Column(dt, _unstage(datas[ci], dt.storage), validity=valids[ci])
                 for ci, dt in enumerate(schema)]
         return Table(cols)

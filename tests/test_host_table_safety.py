@@ -160,3 +160,44 @@ def test_from_rows_rejects_out_of_row_string_slot():
     h = _import(bad2, offs, 1)
     assert not _from_rows(h, [INT32, STRING])
     lib.srjt_rows_free(h)
+
+
+def test_from_rows_rejects_overlapping_string_slots():
+    """Two string columns whose slots both claim the same row tail must be
+    rejected: JCUDF chars are concatenated in column order, so each slot's
+    offset must equal the running cursor.  Overlap would let one crafted row
+    amplify the chars allocation once per string column."""
+    # schema: string + string → slots at 0..8 and 8..16, validity 16, fpv 17,
+    # rows padded to 8 → 24B fixed area
+    n = 1
+    chars = np.frombuffer(b"abcdabcd", dtype=np.uint8).copy()
+    offs = np.array([0, 4], dtype=np.int32)
+    h1 = lib.srjt_column_string(n, _np_ptr(offs), _np_ptr(chars), None)
+    offs2 = np.array([4, 8], dtype=np.int32) - 4
+    h2 = lib.srjt_column_string(n, _np_ptr(offs2), _np_ptr(chars[4:].copy()),
+                                None)
+    arr = (C.c_void_p * 2)(h1, h2)
+    t = lib.srjt_table(arr, 2)
+    lib.srjt_column_free(h1)
+    lib.srjt_column_free(h2)
+    rows = lib.srjt_to_rows(t)
+    assert rows
+    size = lib.srjt_rows_batch_size(rows, 0)
+    buf = np.ctypeslib.as_array(lib.srjt_rows_batch_data(rows, 0),
+                                shape=(size,)).copy()
+    lib.srjt_rows_free(rows)
+    lib.srjt_table_free(t)
+
+    offsets = np.array([0, size], dtype=np.int32)
+    h = _import(buf, offsets, 1)
+    back = _from_rows(h, [STRING, STRING])
+    assert back                       # clean bytes round-trip
+    lib.srjt_table_free(back)
+    lib.srjt_rows_free(h)
+
+    # make the SECOND slot's offset point back at the first column's chars
+    bad = buf.copy()
+    bad[8:12] = bad[0:4]              # slot2.offset := slot1.offset
+    h = _import(bad, offsets, 1)
+    assert not _from_rows(h, [STRING, STRING])
+    lib.srjt_rows_free(h)

@@ -1,0 +1,232 @@
+"""ops.strings differential tests (pandas/python oracle).
+
+Mirrors the reference's reliance on libcudf strings (SURVEY §2.9): the
+operations here are the ones the Spark plugin needs for string sort keys,
+string group-by keys, string equi-join keys, and TPC-DS-shaped predicates.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_rapids_jni_tpu as sr
+from spark_rapids_jni_tpu import Column, Table
+from spark_rapids_jni_tpu.ops import strings as S
+from spark_rapids_jni_tpu.ops import (groupby_aggregate, inner_join,
+                                      left_join, order_by, sort_table)
+
+WORDS = ["", "a", "b", "aa", "ab", "spark", "tpu", "tpu-native", "Spark",
+         "SPARK", "zz", "a\x00b", "a\x00", "longer string payload",
+         "unicode ✓ bytes", "ab"]
+
+
+def make_strings(n, seed=0, null_every=None):
+    rng = np.random.default_rng(seed)
+    vals = [WORDS[i] for i in rng.integers(0, len(WORDS), n)]
+    if null_every:
+        vals = [None if i % null_every == 0 else v
+                for i, v in enumerate(vals)]
+    return vals
+
+
+# ---- dictionary encode ----------------------------------------------------
+
+def test_dictionary_encode_roundtrip():
+    vals = make_strings(97, seed=1)
+    col = Column.strings_from_list(vals)
+    codes, uniq = S.dictionary_encode(col)
+    uniq_list = uniq.to_pylist()
+    got = [uniq_list[c] for c in codes.data.tolist()]
+    assert got == vals
+
+
+def test_dictionary_encode_order_preserving():
+    vals = make_strings(80, seed=2)
+    col = Column.strings_from_list(vals)
+    codes, _ = S.dictionary_encode(col)
+    c = codes.data.tolist()
+    for i in range(len(vals)):
+        for j in range(i + 1, len(vals)):
+            bi, bj = vals[i].encode(), vals[j].encode()
+            if bi < bj:
+                assert c[i] < c[j], (vals[i], vals[j])
+            elif bi == bj:
+                assert c[i] == c[j]
+            else:
+                assert c[i] > c[j]
+
+
+def test_dictionary_encode_nulls_share_code():
+    vals = make_strings(50, seed=3, null_every=7)
+    col = Column.strings_from_list(vals)
+    codes, _ = S.dictionary_encode(col)
+    null_codes = {codes.data[i].item() for i, v in enumerate(vals) if v is None}
+    assert len(null_codes) == 1
+    assert codes.to_pylist() == [None if v is None else codes.data[i].item()
+                                 for i, v in enumerate(vals)]
+
+
+def test_dictionary_representative_prefers_valid_rows():
+    """A masked-null row keeps its original bytes (mask_table semantics) and
+    shares code 0 with the zeroed key; the dictionary entry for that code
+    must come from a VALID empty-string row, never the null row's payload."""
+    col = Column(
+        sr.string,
+        Column.strings_from_list(["xyz", ""]).data,
+        Column.strings_from_list(["xyz", ""]).offsets,
+        validity=np.asarray([False, True]))
+    import jax.numpy as jnp
+    col = Column(sr.string, col.data, col.offsets,
+                 jnp.asarray([False, True]))
+    codes, uniq = S.dictionary_encode(col)
+    assert codes.data[0] == codes.data[1]      # both map to the zeroed key
+    assert uniq.to_pylist()[int(codes.data[1])] == ""   # not "xyz"
+
+
+def test_groupby_masked_null_string_key():
+    """mask_table + groupby on a string key: the null group key must decode
+    as null, and a real empty string must stay distinct from it."""
+    from spark_rapids_jni_tpu.ops import mask_table
+    t = Table([Column.strings_from_list(["xyz", "", "xyz", ""]),
+               Column.from_numpy(np.asarray([1, 2, 4, 8], dtype=np.int64))])
+    masked = mask_table(t, np.asarray([False, True, True, True]))
+    out = groupby_aggregate(masked, [0], [(1, "sum")])
+    rows = dict(zip(out[0].to_pylist(), out[1].to_numpy().tolist()))
+    # the masked row's VALUE is null too, so the null group sums to 0
+    assert rows == {None: 0, "": 10, "xyz": 4}
+
+
+def test_encode_shared_cross_column_equality():
+    a = Column.strings_from_list(["x", "y", "zz", "y"])
+    b = Column.strings_from_list(["y", "zz", "nope", "x"])
+    ca, cb = S.encode_shared([a, b])
+    assert ca.data[1] == cb.data[0]       # "y" == "y"
+    assert ca.data[2] == cb.data[1]       # "zz" == "zz"
+    assert ca.data[0] == cb.data[3]       # "x" == "x"
+    assert cb.data[2] not in ca.data.tolist()
+
+
+# ---- sort -----------------------------------------------------------------
+
+@pytest.mark.parametrize("null_every", [None, 5])
+@pytest.mark.parametrize("asc", [True, False])
+def test_string_sort_vs_python(asc, null_every):
+    vals = make_strings(61, seed=4, null_every=null_every)
+    t = Table([Column.strings_from_list(vals),
+               Column.from_numpy(np.arange(61, dtype=np.int64))])
+    out = sort_table(t, [0], ascending=[asc], nulls_first=[True])
+    got = out[0].to_pylist()
+    keyed = sorted([v for v in vals if v is not None],
+                   key=lambda s: s.encode(), reverse=not asc)
+    expect = [None] * (len(vals) - len(keyed)) + keyed
+    assert got == expect
+
+
+def test_string_secondary_key_sort():
+    vals = ["b", "a", "b", "a", "c", "a"]
+    nums = np.asarray([2, 3, 1, 1, 0, 2], dtype=np.int32)
+    t = Table([Column.strings_from_list(vals), Column.from_numpy(nums)])
+    out = sort_table(t, [0, 1])
+    df = pd.DataFrame({"s": vals, "n": nums}).sort_values(["s", "n"])
+    assert out[0].to_pylist() == df["s"].tolist()
+    assert out[1].to_numpy().tolist() == df["n"].tolist()
+
+
+# ---- groupby --------------------------------------------------------------
+
+@pytest.mark.parametrize("null_every", [None, 6])
+def test_groupby_string_key_vs_pandas(null_every):
+    vals = make_strings(120, seed=5, null_every=null_every)
+    rng = np.random.default_rng(5)
+    nums = rng.integers(-100, 100, 120).astype(np.int64)
+    t = Table([Column.strings_from_list(vals), Column.from_numpy(nums)])
+    out = groupby_aggregate(t, [0], [(1, "sum"), (1, "count"), (1, "max")])
+
+    df = pd.DataFrame({"k": vals, "v": nums})
+    exp = (df.groupby("k", dropna=False)["v"]
+           .agg(["sum", "count", "max"]).reset_index()
+           .sort_values("k", na_position="first"))
+    got_keys = out[0].to_pylist()
+    exp_keys = [None if (isinstance(k, float) and np.isnan(k)) else k
+                for k in exp["k"].tolist()]
+    assert got_keys == exp_keys
+    np.testing.assert_array_equal(out[1].to_numpy(), exp["sum"].to_numpy())
+    np.testing.assert_array_equal(out[2].to_numpy(), exp["count"].to_numpy())
+    np.testing.assert_array_equal(out[3].to_numpy(), exp["max"].to_numpy())
+
+
+# ---- join -----------------------------------------------------------------
+
+def test_inner_join_string_key_vs_pandas():
+    lk = ["a", "b", "c", "a", "d", "b"]
+    rk = ["b", "a", "e", "b"]
+    lt = Table([Column.strings_from_list(lk),
+                Column.from_numpy(np.arange(6, dtype=np.int64))])
+    rt = Table([Column.strings_from_list(rk),
+                Column.from_numpy(np.arange(10, 14, dtype=np.int64))])
+    out = inner_join(lt, rt, 0, 0)
+    got = sorted(zip(out[1].to_numpy().tolist(), out[3].to_numpy().tolist()))
+    ldf = pd.DataFrame({"k": lk, "lv": np.arange(6)})
+    rdf = pd.DataFrame({"k": rk, "rv": np.arange(10, 14)})
+    exp = sorted(zip(*ldf.merge(rdf, on="k")[["lv", "rv"]].T.values.tolist()))
+    assert got == exp
+
+
+def test_left_join_string_key_null_keys_never_match():
+    lk = ["a", None, "c"]
+    rk = ["a", None]
+    lt = Table([Column.strings_from_list(lk),
+                Column.from_numpy(np.arange(3, dtype=np.int32))])
+    rt = Table([Column.strings_from_list(rk),
+                Column.from_numpy(np.asarray([7, 8], dtype=np.int32))])
+    out = left_join(lt, rt, 0, 0)
+    rows = sorted(zip(out[1].to_pylist(), out[3].to_pylist()))
+    assert rows == [(0, 7), (1, None), (2, None)]
+
+
+# ---- equality / transforms ------------------------------------------------
+
+def test_equal_to_and_scalar():
+    a = Column.strings_from_list(["x", "yy", None, "z", ""])
+    b = Column.strings_from_list(["x", "y", "q", None, ""])
+    eq = S.equal_to(a, b)
+    assert eq.to_pylist() == [True, False, None, None, True]
+    eqs = S.equal_to_scalar(a, "x")
+    assert eqs.to_pylist() == [True, False, None, False, False]
+
+
+def test_upper_lower():
+    vals = ["Spark", "TPU", "mixed Case 123", None, ""]
+    col = Column.strings_from_list(vals)
+    assert S.upper(col).to_pylist() == [
+        None if v is None else v.upper() for v in vals]
+    assert S.lower(col).to_pylist() == [
+        None if v is None else v.lower() for v in vals]
+
+
+@pytest.mark.parametrize("start,length", [(0, 3), (2, None), (1, 1), (5, 4)])
+def test_substring(start, length):
+    vals = ["hello", "ab", "", None, "longer payload"]
+    col = Column.strings_from_list(vals)
+    out = S.substring(col, start, length)
+    expect = [None if v is None else
+              (v[start:] if length is None else v[start:start + length])
+              for v in vals]
+    assert out.to_pylist() == expect
+
+
+def test_concat():
+    a = Column.strings_from_list(["x", "", None, "ab"])
+    b = Column.strings_from_list(["1", "2", "3", None])
+    out = S.concat(a, b)
+    assert out.to_pylist() == ["x1", "2", None, None]
+
+
+def test_strings_roundtrip_through_rowconv():
+    """String columns keyed ops compose with the JCUDF transcode."""
+    vals = make_strings(40, seed=9, null_every=11)
+    t = Table([Column.strings_from_list(vals),
+               Column.from_numpy(np.arange(40, dtype=np.int64))])
+    batches = sr.convert_to_rows(t)
+    back = sr.convert_from_rows(batches[0], t.schema)
+    assert back[0].to_pylist() == vals
