@@ -29,21 +29,17 @@ FIXED_COLS = 212       # benchmarks/row_conversion.cpp:38
 VARIABLE_COLS = 155    # benchmarks/row_conversion.cpp:74
 
 
-def _row_conversion_bench(state):
-    n_rows = state["rows"]
-    with_strings = state.params.get("strings", False)
-    n_cols = VARIABLE_COLS if "strings" in state.params else FIXED_COLS
-    # short strings keep the 155-col row under the 1KB JCUDF row limit
-    table = create_random_table(
-        cycled_schema(n_cols, include_strings=with_strings), n_rows,
-        max_string_len=10)
+def _make_closure(state, table):
+    """Shared carry-chained closure machinery (harness.tie discipline):
+    tie one payload buffer to the previous iteration's carry so chained
+    iterations provably execute under a single final sync."""
+    from spark_rapids_jni_tpu.column import Column, Table as _Table
+    from spark_rapids_jni_tpu.rowconv.convert import RowBatch
+
     batches = convert_to_rows(table)
     state.bytes_per_iter = sum(b.num_bytes for b in batches)
 
-    # tie one payload buffer to the previous iteration's carry so chained
-    # iterations provably execute under a single final sync (harness.tie)
     if state["direction"] == "to_row":
-        from spark_rapids_jni_tpu.column import Column, Table as _Table
         fold_ci = next(i for i, c in enumerate(table.columns)
                        if c.dtype.is_fixed_width)
 
@@ -54,7 +50,6 @@ def _row_conversion_bench(state):
                                    c0.offsets, c0.validity)
             return [b.data for b in convert_to_rows(_Table(cols))]
     else:
-        from spark_rapids_jni_tpu.rowconv.convert import RowBatch
         schema = table.schema
 
         def closure(carry):
@@ -65,6 +60,27 @@ def _row_conversion_bench(state):
                             convert_from_rows(bb, schema).columns)
             return outs
     return closure
+
+
+def _row_conversion_bench(state):
+    n_rows = state["rows"]
+    with_strings = state.params.get("strings", False)
+    n_cols = VARIABLE_COLS if "strings" in state.params else FIXED_COLS
+    # short strings keep the 155-col row under the 1KB JCUDF row limit
+    table = create_random_table(
+        cycled_schema(n_cols, include_strings=with_strings), n_rows,
+        max_string_len=10)
+    return _make_closure(state, table)
+
+
+def _spark_shaped_bench(state):
+    """Realistic Spark row shape: a dozen fixed columns + two string columns
+    of ~20 chars — the regime the ragged DMA engine targets (the 155-col
+    synthetic state above routes to the XLA fallback by design)."""
+    table = create_random_table(
+        cycled_schema(12, include_strings=True, string_every=6),
+        state["rows"], max_string_len=40)
+    return _make_closure(state, table)
 
 
 def build_benches(full: bool):
@@ -78,7 +94,12 @@ def build_benches(full: bool):
         # reference skips string states above 1M rows (:117-120)
         skip=lambda s: ("string case skipped above 1M rows"
                         if s["strings"] and s["rows"] > (1 << 20) else None))
-    return [fixed, variable]
+    spark_shaped = Bench(
+        "spark_shaped_strings", _spark_shaped_bench,
+        axes={"rows": rows, "direction": ["to_row", "from_row"]},
+        skip=lambda s: ("skipped above 1M rows"
+                        if s["rows"] > (1 << 20) else None))
+    return [fixed, variable, spark_shaped]
 
 
 def main():

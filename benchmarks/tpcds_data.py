@@ -1,0 +1,84 @@
+"""Mini TPC-DS-shaped data generator (BASELINE config #3 subset).
+
+Generates the slice of the TPC-DS schema the query subset needs —
+``store_sales`` fact plus ``item`` / ``date_dim`` / ``store`` dimensions —
+as Snappy Parquet bytes via pyarrow (the independent writer/oracle, as in
+the decode tests).  Shapes follow the spec's spirit: surrogate-key joins,
+low-cardinality string dimensions (brand/category/state), decimal-valued
+measures carried as scaled int64 cents (the framework's decimal64
+representation, ``RowConversion.java:114-118``).
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+CATEGORIES = ["Books", "Home", "Electronics", "Jewelry", "Music",
+              "Shoes", "Sports", "Women", "Men", "Children"]
+STATES = ["TN", "CA", "TX", "WA", "NY", "GA", "OH", "IL"]
+
+
+def _parquet(table: pa.Table) -> bytes:
+    buf = io.BytesIO()
+    pq.write_table(table, buf, compression="SNAPPY", use_dictionary=False)
+    return buf.getvalue()
+
+
+def generate(n_sales: int = 100_000, n_items: int = 2000,
+             n_dates: int = 366 * 3, n_stores: int = 12,
+             seed: int = 42) -> dict[str, bytes]:
+    rng = np.random.default_rng(seed)
+
+    item = pa.table({
+        "i_item_sk": pa.array(np.arange(1, n_items + 1, dtype=np.int32)),
+        "i_brand_id": pa.array(
+            rng.integers(1000, 1100, n_items).astype(np.int32)),
+        "i_brand": pa.array(
+            [f"brand#{b}" for b in rng.integers(1, 60, n_items)]),
+        "i_category_id": pa.array(
+            rng.integers(1, len(CATEGORIES) + 1, n_items).astype(np.int32)),
+        "i_category": pa.array(
+            [CATEGORIES[c] for c in rng.integers(0, len(CATEGORIES),
+                                                 n_items)]),
+        "i_manufact_id": pa.array(
+            rng.integers(1, 1000, n_items).astype(np.int32)),
+        "i_manager_id": pa.array(
+            rng.integers(1, 100, n_items).astype(np.int32)),
+    })
+
+    date_dim = pa.table({
+        "d_date_sk": pa.array(np.arange(1, n_dates + 1, dtype=np.int32)),
+        "d_year": pa.array(
+            (1999 + (np.arange(n_dates) // 366)).astype(np.int32)),
+        "d_moy": pa.array(
+            (1 + (np.arange(n_dates) // 30) % 12).astype(np.int32)),
+    })
+
+    store = pa.table({
+        "s_store_sk": pa.array(np.arange(1, n_stores + 1, dtype=np.int32)),
+        "s_state": pa.array(
+            [STATES[s] for s in rng.integers(0, len(STATES), n_stores)]),
+    })
+
+    # decimal(7,2) measures as int64 cents (decimal64 scale -2)
+    price_cents = rng.integers(100, 300_00, n_sales).astype(np.int64)
+    qty = rng.integers(1, 100, n_sales).astype(np.int32)
+    store_sales = pa.table({
+        "ss_sold_date_sk": pa.array(
+            rng.integers(1, n_dates + 1, n_sales).astype(np.int32)),
+        "ss_item_sk": pa.array(
+            rng.integers(1, n_items + 1, n_sales).astype(np.int32)),
+        "ss_store_sk": pa.array(
+            rng.integers(1, n_stores + 1, n_sales).astype(np.int32)),
+        "ss_quantity": pa.array(qty),
+        "ss_sales_price_cents": pa.array(price_cents),
+        "ss_ext_sales_price": pa.array(
+            (price_cents * qty).astype(np.float64) / 100.0),
+    })
+
+    return {"store_sales": _parquet(store_sales), "item": _parquet(item),
+            "date_dim": _parquet(date_dim), "store": _parquet(store)}

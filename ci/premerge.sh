@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Premerge gate — the analog of the reference's ci/premerge-build.sh:26-29
+# (`mvn verify -DBUILD_TESTS=ON` on a device runner): build the native
+# artifact, stamp build provenance, run the full test suite, and — when a
+# JDK is present — compile the Java tier.
+#
+# Usage: ci/premerge.sh [--skip-tests]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== native build =="
+make -C spark_rapids_jni_tpu/native -s clean
+make -C spark_rapids_jni_tpu/native -s -j"$(nproc)"
+
+echo "== build provenance =="
+python ci/build_info.py
+
+if command -v javac >/dev/null 2>&1; then
+    echo "== java tier =="
+    mkdir -p target/java-classes
+    javac -d target/java-classes $(find java -name '*.java')
+else
+    echo "== java tier: no javac in environment, skipped =="
+fi
+
+if [[ "${1:-}" != "--skip-tests" ]]; then
+    echo "== tests =="
+    python -m pytest tests/ -q
+fi
+
+echo "premerge OK"

@@ -33,4 +33,11 @@ from .rowconv import (  # noqa: E402,F401
     convert_to_rows, convert_from_rows,
 )
 
-__version__ = "0.1.0"
+# stamped by ci/build_info.py (build/build-info:26-40 analog); falls back
+# to the static base version when no build provenance has been generated
+try:
+    from .version_info import version as __version__  # noqa: F401
+    from . import version_info  # noqa: F401
+except ImportError:
+    from ._version import BASE_VERSION as __version__  # noqa: F401
+    version_info = None

@@ -1,0 +1,8 @@
+"""Single source of the base version string.
+
+Imported by the package ``__init__`` (fallback when no build-provenance
+stamp exists) and read by ``ci/build_info.py`` when stamping — keeping the
+two from drifting.
+"""
+
+BASE_VERSION = "0.2.0-dev"

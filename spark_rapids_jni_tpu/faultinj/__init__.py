@@ -1,2 +1,4 @@
 from .injector import (FaultInjector, fault_site, get_injector,  # noqa: F401
                        enable, disable)
+from .resilience import DeviceQuarantined, ResilientExecutor  # noqa: F401
+from . import jax_shim  # noqa: F401

@@ -1,0 +1,110 @@
+"""JAX-boundary fault interception — the CUPTI-shim analog.
+
+The reference's ``libcufaultinj.so`` subscribes to CUPTI's runtime+driver
+callback domains and therefore sees *every* CUDA API call, not just named
+framework functions (``faultinj.cu:125-131``).  The TPU equivalent of "the
+API layer below the framework" is JAX's dispatch machinery; this module
+monkeypatches the three churn points every device interaction funnels
+through and routes them to the same injector/rule engine as the framework
+sites:
+
+=================  ==========================================  ============
+site name          patched seam                                CUDA analog
+=================  ==========================================  ============
+``jax.device_put``  ``jax._src.dispatch.device_put_p.impl``     cudaMemcpy
+``jax.compile``     ``jax._src.compiler.backend_compile``       cuModuleLoad
+``jax.execute``     ``pxla.ExecuteReplicated.__call__``         cuLaunchKernel
+=================  ==========================================  ============
+
+Rules use the same JSON schema (percent / interceptionCount /
+injectionType, ``faultinj/README.md:104-141``) keyed by the site names
+above (or ``"*"``).  ``substitute`` is not meaningful at this layer (there
+is no scalar return code to overwrite) and is treated as ``device_error``.
+
+Usage::
+
+    from spark_rapids_jni_tpu.faultinj import jax_shim
+    jax_shim.install()          # idempotent
+    ...
+    jax_shim.uninstall()
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+
+from .injector import get_injector
+
+_LOCK = threading.Lock()
+_PATCHED: dict[str, tuple] = {}
+
+
+def _intercept(site: str, fn, *args, **kwargs):
+    hit = get_injector().check(site)
+    if hit is not None:
+        # a substituted value makes no sense for compile/execute/transfer —
+        # escalate like the reference's trap kernel
+        from .injector import InjectedDeviceError
+        raise InjectedDeviceError(
+            f"[faultinj] injected device error at site {site!r}")
+    return fn(*args, **kwargs)
+
+
+def install() -> list[str]:
+    """Patch the JAX seams (idempotent).  Returns the site names active.
+
+    Caches are cleared so existing executables re-enter the Python dispatch
+    path.  Known limitation vs CUPTI: once a computation has executed, JAX's
+    C++ fastpath dispatches cache hits without touching Python, so repeat
+    executions of the *same* jitted signature bypass the ``jax.execute``
+    site — every compile, transfer, and first execution is still seen.
+    """
+    with _LOCK:
+        if _PATCHED:
+            return list(_PATCHED)
+        import jax
+        jax.clear_caches()
+
+        import jax._src.compiler as _compiler
+        orig_compile = _compiler.backend_compile
+
+        @functools.wraps(orig_compile)
+        def compile_shim(*a, **k):
+            return _intercept("jax.compile", orig_compile, *a, **k)
+
+        _compiler.backend_compile = compile_shim
+        _PATCHED["jax.compile"] = (_compiler, "backend_compile", orig_compile)
+
+        from jax._src.interpreters import pxla as _pxla
+        orig_call = _pxla.ExecuteReplicated.__call__
+
+        @functools.wraps(orig_call)
+        def call_shim(self, *a, **k):
+            return _intercept("jax.execute", orig_call, self, *a, **k)
+
+        _pxla.ExecuteReplicated.__call__ = call_shim
+        _PATCHED["jax.execute"] = (_pxla.ExecuteReplicated, "__call__",
+                                   orig_call)
+
+        import jax._src.dispatch as _dispatch
+        orig_put = _dispatch.device_put_p.impl
+
+        @functools.wraps(orig_put)
+        def put_shim(*a, **k):
+            return _intercept("jax.device_put", orig_put, *a, **k)
+
+        _dispatch.device_put_p.impl = put_shim
+        _PATCHED["jax.device_put"] = (_dispatch.device_put_p, "impl", orig_put)
+        return list(_PATCHED)
+
+
+def uninstall() -> None:
+    with _LOCK:
+        for holder, name, orig in _PATCHED.values():
+            setattr(holder, name, orig)
+        _PATCHED.clear()
+
+
+def installed() -> bool:
+    return bool(_PATCHED)

@@ -1,0 +1,63 @@
+"""Retry/quarantine policy harness — the "framework above" contract.
+
+The reference's fault injector exists to prove that the framework above the
+native library (Spark + the RAPIDS plugin) reacts correctly to GPU faults:
+non-fatal errors are retried, fatal errors quarantine the executor, and
+nothing deadlocks (``faultinj/README.md:3-16``).  This module provides the
+same contract for this framework so resilience tests have a first-party
+subject: a :class:`ResilientExecutor` that classifies failures from the
+device layer (including the JAX-boundary shim's injections) and applies
+Spark-like policy.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+from .injector import InjectedDeviceError, InjectedOomError
+
+
+class DeviceQuarantined(RuntimeError):
+    """The executor refused work because a fatal device fault occurred."""
+
+
+class ResilientExecutor:
+    """Runs device closures with retry (transient) / quarantine (fatal).
+
+    Policy mirrors the Spark executor contract the reference's tool tests
+    (``faultinj/README.md:3-16``): allocation failures and other transient
+    errors are retried up to ``max_retries`` with backoff; a device error
+    (the PTX-trap analog, :class:`InjectedDeviceError`) is fatal — the
+    executor quarantines itself and every subsequent submit fails fast.
+    """
+
+    def __init__(self, max_retries: int = 2, backoff_s: float = 0.0):
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+        self.quarantined = False
+        self.retry_count = 0      # observability
+        self.fatal_count = 0
+
+    def submit(self, fn: Callable[[], Any]) -> Any:
+        if self.quarantined:
+            raise DeviceQuarantined("executor is quarantined")
+        attempts = 0
+        while True:
+            try:
+                return fn()
+            except InjectedDeviceError:
+                # fatal: device state unknown — quarantine (the plugin's
+                # "shut down the executor so the cluster manager replaces
+                # it" behavior)
+                self.fatal_count += 1
+                self.quarantined = True
+                raise DeviceQuarantined(
+                    "fatal device fault — executor quarantined")
+            except (InjectedOomError, MemoryError):
+                attempts += 1
+                if attempts > self.max_retries:
+                    raise
+                self.retry_count += 1
+                if self.backoff_s:
+                    time.sleep(self.backoff_s)

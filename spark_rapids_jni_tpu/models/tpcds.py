@@ -1,0 +1,175 @@
+"""TPC-DS query subset (BASELINE config #3): join + groupby + strings +
+decimals end-to-end on the framework's op library.
+
+Five queries shaped after the spec's reporting family (Q3 / Q42 / Q52 /
+Q55, plus a store-state rollup exercising decimal aggregation) run against
+the mini generator in ``benchmarks/tpcds_data.py``.  Every query is scan
+(``parquet.decode`` incl. the Snappy path) → compacting filters → sort-probe
+equi-joins → sort-based groupby with string keys (dictionary-encoded,
+``ops.strings``) → deterministic key-ordered output, differentially tested
+against pandas running the same plan (tests/test_tpcds.py).
+
+The reference reaches this tier through libcudf's join/groupby/strings
+(SURVEY §2.9); the TPU formulation is the op library's: no hash tables, no
+dynamic shapes outside the two-phase sync points.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from ..column import Column, Table
+from ..ops import (apply_boolean_mask, groupby_aggregate, inner_join,
+                   sort_table)
+from ..ops import strings as S
+from ..parquet import decode
+
+SS_COLS = ["ss_sold_date_sk", "ss_item_sk", "ss_store_sk", "ss_quantity",
+           "ss_sales_price_cents", "ss_ext_sales_price"]
+ITEM_COLS = ["i_item_sk", "i_brand_id", "i_brand", "i_category_id",
+             "i_category", "i_manufact_id", "i_manager_id"]
+DATE_COLS = ["d_date_sk", "d_year", "d_moy"]
+STORE_COLS = ["s_store_sk", "s_state"]
+
+
+def load_tables(files: dict[str, bytes]) -> dict[str, Table]:
+    return {
+        "store_sales": decode.read_table(files["store_sales"],
+                                         columns=SS_COLS),
+        "item": decode.read_table(files["item"], columns=ITEM_COLS),
+        "date_dim": decode.read_table(files["date_dim"], columns=DATE_COLS),
+        "store": decode.read_table(files["store"], columns=STORE_COLS),
+    }
+
+
+def _eq_scalar_mask(col: Column, value) -> "np.ndarray":
+    import jax.numpy as jnp
+    if col.dtype.id == T.TypeId.STRING:
+        b = S.equal_to_scalar(col, value)
+        m = b.data.astype(bool)
+        return m if b.validity is None else (m & b.validity)
+    m = col.data == value
+    return m if col.validity is None else (m & col.validity)
+
+
+def _col(table: Table, cols: list[str], name: str) -> int:
+    return cols.index(name)
+
+
+def q3(tables: dict[str, Table], manufact_id: int = 436,
+       moy: int = 11) -> Table:
+    """SELECT d_year, i_brand_id, i_brand, sum(ss_ext_sales_price)
+    FROM store_sales ⋈ item ⋈ date_dim
+    WHERE i_manufact_id = ? AND d_moy = ?
+    GROUP BY d_year, i_brand_id, i_brand ORDER BY keys."""
+    ss, item, dd = tables["store_sales"], tables["item"], tables["date_dim"]
+    item_f = apply_boolean_mask(
+        item, _eq_scalar_mask(item[_col(item, ITEM_COLS, "i_manufact_id")],
+                              manufact_id))
+    dd_f = apply_boolean_mask(
+        dd, _eq_scalar_mask(dd[_col(dd, DATE_COLS, "d_moy")], moy))
+    j1 = inner_join(ss, item_f, _col(ss, SS_COLS, "ss_item_sk"),
+                    _col(item, ITEM_COLS, "i_item_sk"))
+    # j1 columns: SS_COLS ++ ITEM_COLS
+    j2 = inner_join(j1, dd_f, _col(ss, SS_COLS, "ss_sold_date_sk"),
+                    _col(dd, DATE_COLS, "d_date_sk"))
+    cols = SS_COLS + ITEM_COLS + DATE_COLS
+    out = groupby_aggregate(
+        j2,
+        [cols.index("d_year"), cols.index("i_brand_id"),
+         cols.index("i_brand")],
+        [(cols.index("ss_ext_sales_price"), "sum")])
+    return sort_table(out, [0, 1, 2])
+
+
+def q42(tables: dict[str, Table], manager_id: int = 1, year: int = 2000,
+        moy: int = 11) -> Table:
+    """GROUP BY d_year, i_category_id, i_category with manager/date
+    predicates (Q42 shape)."""
+    ss, item, dd = tables["store_sales"], tables["item"], tables["date_dim"]
+    item_f = apply_boolean_mask(
+        item, _eq_scalar_mask(item[_col(item, ITEM_COLS, "i_manager_id")],
+                              manager_id))
+    dd_mask = (_eq_scalar_mask(dd[_col(dd, DATE_COLS, "d_moy")], moy)
+               & _eq_scalar_mask(dd[_col(dd, DATE_COLS, "d_year")], year))
+    dd_f = apply_boolean_mask(dd, dd_mask)
+    j1 = inner_join(ss, item_f, _col(ss, SS_COLS, "ss_item_sk"),
+                    _col(item, ITEM_COLS, "i_item_sk"))
+    j2 = inner_join(j1, dd_f, _col(ss, SS_COLS, "ss_sold_date_sk"),
+                    _col(dd, DATE_COLS, "d_date_sk"))
+    cols = SS_COLS + ITEM_COLS + DATE_COLS
+    out = groupby_aggregate(
+        j2,
+        [cols.index("d_year"), cols.index("i_category_id"),
+         cols.index("i_category")],
+        [(cols.index("ss_ext_sales_price"), "sum")])
+    return sort_table(out, [0, 1, 2])
+
+
+def q52(tables: dict[str, Table], moy: int = 12, year: int = 2001) -> Table:
+    """GROUP BY d_year, i_brand_id, i_brand for one month (Q52 shape)."""
+    ss, item, dd = tables["store_sales"], tables["item"], tables["date_dim"]
+    dd_mask = (_eq_scalar_mask(dd[_col(dd, DATE_COLS, "d_moy")], moy)
+               & _eq_scalar_mask(dd[_col(dd, DATE_COLS, "d_year")], year))
+    dd_f = apply_boolean_mask(dd, dd_mask)
+    j1 = inner_join(ss, dd_f, _col(ss, SS_COLS, "ss_sold_date_sk"),
+                    _col(dd, DATE_COLS, "d_date_sk"))
+    cols1 = SS_COLS + DATE_COLS
+    j2 = inner_join(j1, tables["item"], cols1.index("ss_item_sk"),
+                    _col(item, ITEM_COLS, "i_item_sk"))
+    cols = cols1 + ITEM_COLS
+    out = groupby_aggregate(
+        j2,
+        [cols.index("d_year"), cols.index("i_brand_id"),
+         cols.index("i_brand")],
+        [(cols.index("ss_ext_sales_price"), "sum")])
+    return sort_table(out, [0, 1, 2])
+
+
+def q55(tables: dict[str, Table], manager_id: int = 28) -> Table:
+    """GROUP BY i_brand_id, i_brand for one manager (Q55 shape)."""
+    ss, item = tables["store_sales"], tables["item"]
+    item_f = apply_boolean_mask(
+        item, _eq_scalar_mask(item[_col(item, ITEM_COLS, "i_manager_id")],
+                              manager_id))
+    j1 = inner_join(ss, item_f, _col(ss, SS_COLS, "ss_item_sk"),
+                    _col(item, ITEM_COLS, "i_item_sk"))
+    cols = SS_COLS + ITEM_COLS
+    out = groupby_aggregate(
+        j1, [cols.index("i_brand_id"), cols.index("i_brand")],
+        [(cols.index("ss_ext_sales_price"), "sum")])
+    return sort_table(out, [0, 1])
+
+
+def q_state_rollup(tables: dict[str, Table], state: str = "TN") -> Table:
+    """Store-state rollup with decimal aggregation: the s_state string
+    predicate + decimal64(-2) sales-price sum and quantity mean."""
+    ss, store = tables["store_sales"], tables["store"]
+    store_f = apply_boolean_mask(
+        store, _eq_scalar_mask(store[_col(store, STORE_COLS, "s_state")],
+                               state))
+    j1 = inner_join(ss, store_f, _col(ss, SS_COLS, "ss_store_sk"),
+                    _col(store, STORE_COLS, "s_store_sk"))
+    cols = SS_COLS + STORE_COLS
+    # the cents column IS the unscaled decimal payload — reinterpret as
+    # decimal64(scale -2) (RowConversion.java:114-118 representation);
+    # sum keeps the scale
+    price_i = cols.index("ss_sales_price_cents")
+    work = list(j1.columns)
+    work[price_i] = Column(T.decimal64(-2), j1[price_i].data,
+                           validity=j1[price_i].validity)
+    out = groupby_aggregate(
+        Table(work), [cols.index("s_state")],
+        [(price_i, "sum"), (cols.index("ss_quantity"), "mean"),
+         (cols.index("ss_quantity"), "count")])
+    return sort_table(out, [0])
+
+
+QUERIES = {"q3": q3, "q42": q42, "q52": q52, "q55": q55,
+           "q_state_rollup": q_state_rollup}
+
+
+def run_all(files: dict[str, bytes]) -> dict[str, Table]:
+    tables = load_tables(files)
+    return {name: fn(tables) for name, fn in QUERIES.items()}

@@ -76,7 +76,8 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
     """
     n = table.num_rows
     if n == 0:
-        raise ValueError("groupby of an empty table")
+        # Spark returns an empty result for GROUP BY over no rows
+        return _empty_result(table, key_indices, aggs)
     # string keys: swap in order-preserving dictionary codes (ops.strings) so
     # ordering/segmenting below see plain int32 lanes; the output key columns
     # are decoded from the dictionary at the end
@@ -122,20 +123,40 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
                                num_segments, col.dtype.storage.kind)
             out_cols.append(Column(col.dtype, res.astype(col.dtype.storage),
                                    validity=cnt > 0))
-        elif agg in ("min", "max"):
-            out_cols.append(Column(col.dtype, res.astype(col.dtype.storage)))
         else:
-            from .. import types as T
-            if agg == "mean":
-                dt = T.float64
-            elif agg == "count":
-                dt = T.int64
-            elif col.dtype.is_decimal:       # sum of decimal keeps the scale
-                dt = T.decimal64(col.dtype.scale)
-            else:
-                dt = T.float64 if col.dtype.storage.kind == "f" else T.int64
+            dt = _agg_out_dtype(col.dtype, agg)
             out_cols.append(Column(dt, res.astype(dt.storage)))
     return Table(out_cols)
+
+
+def _agg_out_dtype(src, agg):
+    """Result dtype of an aggregation — the single source for both the
+    populated and the empty-input result paths (schema stability)."""
+    from .. import types as T
+    if agg in ("min", "max"):
+        return src
+    if agg == "mean":
+        return T.float64
+    if agg == "count":
+        return T.int64
+    if src.is_decimal:                   # sum of decimal keeps the scale
+        return T.decimal64(src.scale)
+    return T.float64 if src.storage.kind == "f" else T.int64
+
+
+def _empty_result(table: Table, key_indices, aggs) -> Table:
+    cols = []
+    for ki in key_indices:
+        dt = table[ki].dtype
+        if dt.is_variable_width:
+            cols.append(Column(dt, jnp.zeros(0, jnp.uint8),
+                               jnp.zeros(1, jnp.int32)))
+        else:
+            cols.append(Column(dt, jnp.zeros(0, dt.storage)))
+    for vi, agg in aggs:
+        dt = _agg_out_dtype(table[vi].dtype, agg)
+        cols.append(Column(dt, jnp.zeros(0, dt.storage)))
+    return Table(cols)
 
 
 def _take_rows(col: Column, idx: jnp.ndarray) -> Column:

@@ -11,7 +11,8 @@ Supported (the TPC-H/TPC-DS working set, BASELINE configs #2-#4):
 * physical types BOOLEAN, INT32, INT64, FLOAT, DOUBLE, BYTE_ARRAY
 * encodings PLAIN, RLE, PLAIN_DICTIONARY / RLE_DICTIONARY
 * definition levels (RLE/bit-packed hybrid) for optional flat columns
-* codecs UNCOMPRESSED and GZIP/zlib (stdlib); SNAPPY if python-snappy exists
+* codecs UNCOMPRESSED, GZIP/zlib (stdlib), and SNAPPY (pure-Python decoder
+  in ``parquet/snappy.py``; python-snappy accelerates it when present)
 * data page v1 and v2
 
 Nested columns (max repetition level > 0) are rejected for now.
@@ -101,11 +102,10 @@ def _decompress(data: bytes, codec: int, uncompressed_size: int) -> bytes:
     if codec == CODEC_GZIP:
         return zlib.decompress(data, wbits=31)
     if codec == CODEC_SNAPPY:
-        if _snappy is None:
-            raise NotImplementedError(
-                "snappy codec needs python-snappy (not in this image); "
-                "write with compression=NONE/GZIP")
-        return _snappy.decompress(data)
+        if _snappy is not None:          # optional C accelerator
+            return _snappy.decompress(data)
+        from . import snappy as _pysnappy
+        return _pysnappy.decompress(data, expected_size=uncompressed_size)
     raise NotImplementedError(f"unsupported parquet codec {codec}")
 
 

@@ -235,6 +235,129 @@ def _segment_of(starts: jnp.ndarray, total: int) -> jnp.ndarray:
     return jnp.cumsum(markers)
 
 
+@functools.partial(jax.jit, static_argnums=0)
+def _var_fixed_region(layout: RowLayout, datas: tuple[jnp.ndarray, ...],
+                      str_offsets: tuple[jnp.ndarray, ...],
+                      valid: jnp.ndarray) -> jnp.ndarray:
+    """Dense fixed region [n, fixed_plus_validity] for a variable-width
+    schema: column slots, string (offset,len) slot pairs, validity bytes.
+    Pure vector ops — shared by the DMA and XLA string paths."""
+    n = valid.shape[0]
+    var_idx = layout.variable_column_indices
+    nvar = len(var_idx)
+    fpv = layout.fixed_plus_validity
+    lens = jnp.stack(
+        [str_offsets[vi][1:] - str_offsets[vi][:-1] for vi in range(nvar)],
+        axis=1).astype(jnp.int32)                           # [n, nvar]
+    prefix = jnp.cumsum(lens, axis=1) - lens
+    fixed2d = jnp.zeros((n, fpv), dtype=jnp.uint8)
+    vi_of_ci = {ci: vi for vi, ci in enumerate(var_idx)}
+    for ci, dt in enumerate(layout.schema):
+        start = layout.column_starts[ci]
+        if dt.is_variable_width:
+            vi = vi_of_ci[ci]
+            slot_off = (fpv + prefix[:, vi]).astype(jnp.uint32)
+            slot = jnp.stack([slot_off, lens[:, vi].astype(jnp.uint32)], axis=1)
+            b = jax.lax.bitcast_convert_type(slot, jnp.uint8).reshape(n, 8)
+        else:
+            b = _byte_view(datas[ci], dt.storage)
+        fixed2d = fixed2d.at[:, start:start + b.shape[1]].set(b)
+    vbytes = bitmask.pack_bool_matrix(valid)
+    return fixed2d.at[:, layout.validity_offset:
+                      layout.validity_offset + layout.validity_bytes].set(vbytes)
+
+
+# Above this many string columns the per-column segmented-copy passes (each
+# touching the full char region) lose to the single-pass XLA gather path.
+_DMA_MAX_VAR_COLS = 8
+
+
+def _to_rows_var_dma(layout: RowLayout, sub: "Table", valid: jnp.ndarray,
+                     offs_np: np.ndarray) -> Optional[jnp.ndarray]:
+    """Strings → JCUDF rows via the ragged DMA engine (TPU).
+
+    The reference stages tiles in shared memory and memcpy_asyncs them out
+    (``copy_strings_to_rows``, row_conversion.cu:827-875); here the char
+    region is assembled as dense per-row byte matrices — one
+    :func:`ragged.unpack` when there is a single string column (its chars
+    are already per-row contiguous), else one :func:`ragged.segmented_copy`
+    per column — and one :func:`ragged.pack` flattens the dense rows into
+    the packed JCUDF buffer.  All heavy byte movement is aligned bulk DMA +
+    in-register rolls.
+
+    Returns ``None`` for shapes where the engine loses to the XLA gather
+    formulation (> ``_DMA_MAX_VAR_COLS`` string columns): the per-column
+    passes each traverse the whole char region, so cost grows with the
+    column count while the gather path scales with total bytes only.
+    """
+    from . import ragged
+    n = sub.num_rows
+    var_idx = layout.variable_column_indices
+    nvar = len(var_idx)
+    if nvar > _DMA_MAX_VAR_COLS:
+        return None
+    fpv = layout.fixed_plus_validity
+    offs_np = np.asarray(offs_np, dtype=np.int64)
+    sizes_np = offs_np[1:] - offs_np[:-1]
+    # bucketed to limit distinct jit/kernel shapes (extra columns are zero)
+    M = -(-int(sizes_np.max(initial=8)) // 64) * 64
+    Mc = M - fpv
+
+    col_offs_np = [np.asarray(sub[ci].offsets, dtype=np.int64)
+                   for ci in var_idx]
+    lens_np = np.stack([o[1:] - o[:-1] for o in col_offs_np], axis=1)
+    prefix_np = np.cumsum(lens_np, axis=1) - lens_np
+
+    fixed2d = _var_fixed_region(
+        layout, tuple(_stage(c) for c in sub.columns),
+        tuple(sub[ci].offsets for ci in var_idx), valid)
+
+    total_chars = int(lens_np.sum())
+    if Mc > 0 and total_chars:
+        if nvar == 1:
+            # single string column: chars are already per-row contiguous
+            cr = ragged.unpack(sub[var_idx[0]].data, col_offs_np[0], Mc)
+        else:
+            acc = None
+            row_base_c = np.arange(n, dtype=np.int64) * Mc
+            for vi, ci in enumerate(var_idx):
+                part = ragged.copy_segments(
+                    sub[ci].data, col_offs_np[vi][:-1],
+                    row_base_c + prefix_np[:, vi], lens_np[:, vi], n * Mc)
+                acc = part if acc is None else (acc | part)
+            cr = acc.reshape(n, Mc)
+        dense = jnp.concatenate([fixed2d, cr], axis=1)
+    elif Mc > 0:
+        dense = jnp.concatenate(
+            [fixed2d, jnp.zeros((n, Mc), jnp.uint8)], axis=1)
+    else:
+        dense = fixed2d[:, :M] if fpv >= M else jnp.concatenate(
+            [fixed2d, jnp.zeros((n, M - fpv), jnp.uint8)], axis=1)
+    return ragged.pack(dense, offs_np)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _var_fixed_extract(layout: RowLayout, fixed_dense: jnp.ndarray):
+    """Inverse of :func:`_var_fixed_region`: dense [n, fpv] → (fixed column
+    payloads, validity matrix, per-var-column (offset,len) u32 slots)."""
+    n = fixed_dense.shape[0]
+    datas = []
+    slots = []
+    for ci, dt in enumerate(layout.schema):
+        start = layout.column_starts[ci]
+        if dt.is_variable_width:
+            b = fixed_dense[:, start:start + 8].reshape(n, 2, 4)
+            slots.append(jax.lax.bitcast_convert_type(b, jnp.uint32))
+            datas.append(None)
+        else:
+            b = fixed_dense[:, start:start + layout.column_sizes[ci]]
+            datas.append(_from_bytes(b, dt.storage))
+    vbytes = fixed_dense[:, layout.validity_offset:
+                         layout.validity_offset + layout.validity_bytes]
+    valid = bitmask.unpack_bool_matrix(vbytes, layout.num_columns)
+    return datas, valid, tuple(slots)
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def _to_rows_var(layout: RowLayout, total_bytes: int,
                  datas: tuple[jnp.ndarray, ...],
@@ -476,20 +599,27 @@ def convert_to_rows(table: Table,
     _check_row_size(layout, row_sizes)
 
     batches = build_batches(row_sizes, max_batch_bytes)
+    from . import ragged
+    use_dma = ragged.dma_supported()
     out = []
     for bi, (lo, hi) in enumerate(zip(batches.row_boundaries[:-1],
                                       batches.row_boundaries[1:])):
         sub = Table([_slice_column(c, lo, hi) for c in table.columns])
         valid = _table_valid_matrix(sub)
-        row_offs = jnp.asarray(
-            batches.row_offsets_within_batch[bi].astype(np.int64))
-        data = _to_rows_var(
-            layout, batches.batch_bytes[bi],
-            tuple(_stage(c) for c in sub.columns),
-            # _slice_column already rebases string offsets to zero
-            tuple(sub[ci].offsets
-                  for ci in layout.variable_column_indices),
-            valid, row_offs)
+        data = None
+        if use_dma:
+            data = _to_rows_var_dma(
+                layout, sub, valid, batches.row_offsets_within_batch[bi])
+        if data is None:
+            row_offs = jnp.asarray(
+                batches.row_offsets_within_batch[bi].astype(np.int64))
+            data = _to_rows_var(
+                layout, batches.batch_bytes[bi],
+                tuple(_stage(c) for c in sub.columns),
+                # _slice_column already rebases string offsets to zero
+                tuple(sub[ci].offsets
+                      for ci in layout.variable_column_indices),
+                valid, row_offs)
         out.append(RowBatch(
             data, jnp.asarray(batches.row_offsets_within_batch[bi])))
     return out
@@ -530,6 +660,42 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
         cols = [Column(dt, _unstage(datas[ci], dt.storage), validity=valids[ci])
                 for ci, dt in enumerate(schema)]
         return Table(cols)
+
+    from . import ragged
+    if (ragged.dma_supported()
+            and len(layout.variable_column_indices) <= _DMA_MAX_VAR_COLS):
+        # DMA path (copy_strings_from_rows analog, row_conversion.cu:
+        # 1131-1174): the fixed region of every row is pulled into one
+        # dense matrix, decomposed with static slices; each string
+        # column's chars are then one segmented copy.  The host sync on
+        # the (offset,len) slots mirrors the reference's sync on the
+        # scanned char totals (row_conversion.cu:2215).
+        offs_np = np.asarray(batch.offsets, dtype=np.int64)
+        row_base_np = offs_np[:-1]
+        fixed_dense = ragged.unpack(batch.data, offs_np,
+                                    layout.fixed_plus_validity)
+        datas, valid, slots = _var_fixed_extract(layout, fixed_dense)
+        row_sizes_np = offs_np[1:] - offs_np[:-1]
+        out_offsets = []
+        chars = []
+        for vi in range(len(layout.variable_column_indices)):
+            s = np.asarray(slots[vi], dtype=np.int64)       # host sync
+            lens = s[:, 1]
+            # rows may be shuffle-received: validate the embedded slots
+            # before sizing any allocation (same hardening as the C++ host
+            # engine, host_table.cpp srjt_from_rows)
+            if ((s[:, 0] < layout.fixed_plus_validity)
+                    | (s[:, 0] + lens > row_sizes_np)).any():
+                raise ValueError(
+                    "corrupt row data: string slot outside its row")
+            offs = np.zeros(n + 1, dtype=np.int64)
+            np.cumsum(lens, out=offs[1:])
+            out_offsets.append(jnp.asarray(offs))
+            chars.append(ragged.copy_segments(
+                batch.data, row_base_np + s[:, 0], offs[:-1], lens,
+                int(offs[-1])))
+        return _assemble(schema, datas, valid, tuple(chars),
+                         [o.astype(jnp.int32) for o in out_offsets])
 
     row_offsets = batch.offsets.astype(jnp.int64)
 

@@ -1,0 +1,659 @@
+"""Ragged↔dense byte movement on TPU — the segmented-copy engine.
+
+This is the TPU-native answer to the reference's variable-width CUDA kernels
+(``copy_strings_to_rows`` warp-per-row ``memcpy_async``,
+``row_conversion.cu:827-875``, and ``copy_strings_from_rows``,
+``:1131-1174``).  Three facts about the hardware/toolchain dictate the
+design (all measured on v5e, see BASELINE.md):
+
+* XLA's 1D gather scalarizes (~0.1 Gelem/s) — per-element indexing is not a
+  usable primitive for byte movement;
+* per-DMA issue rate tops out ~1.4 M/s, so per-row DMAs cap at ~1 GB/s for
+  typical row sizes;
+* Mosaic DMA slices must be tile-aligned (512B windows), but in-register
+  dynamic rolls (``pltpu.roll``) are cheap on 32-bit lanes.
+
+So the kernels here move *aligned bulk windows* with a handful of DMAs per
+output block and do the unaligned placement with vector rolls — exactly the
+reference's "stage tiles in shared memory, blast out coalesced" pattern
+(``row_conversion.cu:575-693``) with VMEM in the role of shmem and a
+byte-roll in the role of the per-thread shuffle.
+
+Segments are byte-granular: offsets and sizes need no alignment.  The only
+structural requirement is monotonicity (segment k's source lies before
+segment k+1's), which holds for every use in this package: JCUDF row
+pack/unpack, per-column string extraction, and ordered string gathers.
+
+Public entry points (host-metadata + device-array in, device-array out):
+
+* :func:`pack_rows`   — dense [n, M] (zero-padded rows) → packed flat bytes
+* :func:`unpack_rows` — packed flat bytes → dense [n, M] (zero-padded)
+
+Both take the segment offsets as a **host** numpy array (the row geometry is
+host-resident everywhere in the JCUDF path — the reference makes the same
+host/device split: batch/tile metadata on host, bytes on device).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LANE = 128
+_WINDOW_ALIGN = 512          # bytes; Mosaic DMA minor-dim tile for u32
+
+
+def dma_supported() -> bool:
+    """The Pallas DMA path runs on real TPU backends only (interpret mode
+    does not model the DMA/semaphore pipeline faithfully enough to be worth
+    maintaining); elsewhere the XLA fallback is used."""
+    if os.environ.get("SRJT_RAGGED_DMA", "auto").lower() in ("0", "off"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _pow2_bucket(x: int, lo: int = 8) -> int:
+    """Round up to a power of two (≥ lo).
+
+    Every data-dependent static the kernels take (block counts, window
+    sublanes, metadata rows, padded segment counts) is bucketed so that
+    calls with nearby geometry share one compiled kernel — each unique
+    static tuple costs a ~35 s Mosaic compile through the remote helper,
+    and e.g. a 50-string-column table would otherwise compile ~50 variants.
+    """
+    v = lo
+    while v < x:
+        v <<= 1
+    return v
+
+
+def _soft_bucket(x: int, lo: int = 8) -> int:
+    """Bucket with ≤ ~12.5% growth: round up to a multiple of pow2(x)/8.
+
+    Used for sizes where doubling would be wasteful (input paddings, grid
+    block counts); still collapses the compile-key space to a few dozen
+    values.
+    """
+    x = max(x, lo)
+    p = _pow2_bucket(x, lo)
+    step = max(lo, p // 8)
+    return _round_up(x, step)
+
+
+# ---------------------------------------------------------------------------
+# padding-safe u8 ↔ u32 reinterpretation
+#
+# jnp.reshape(x, (-1, 4)) + bitcast materializes a (…, 4)-minor array whose
+# TPU tiled layout pads the minor dim to 128 — a 32× HBM blow-up that OOMs
+# at GB scale.  These helpers keep every intermediate ≥ 512B-minor.
+# ---------------------------------------------------------------------------
+
+def u8_to_u32(x: jnp.ndarray) -> jnp.ndarray:
+    """u8 [4N] → u32 [N] (little-endian), N multiple of 128."""
+    x2 = x.reshape(-1, 4 * LANE)
+    parts = [x2[:, k::4].astype(jnp.uint32) for k in range(4)]
+    w = parts[0] | (parts[1] << 8) | (parts[2] << 16) | (parts[3] << 24)
+    return w.reshape(-1)
+
+
+def u32_to_u8(w: jnp.ndarray) -> jnp.ndarray:
+    """u32 [N] → u8 [4N], N multiple of 128."""
+    w2 = w.reshape(-1, LANE)
+    out = jnp.zeros((w2.shape[0], 4 * LANE), jnp.uint8)
+    for k in range(4):
+        out = out.at[:, k::4].set(((w2 >> (8 * k)) & 0xFF).astype(jnp.uint8))
+    return out.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# in-kernel primitives
+# ---------------------------------------------------------------------------
+
+def _flat_roll(x2d, shift_words):
+    """Circular roll of a [S, 128] u32 register block in flat row-major
+    word order, dynamic (possibly negative) shift."""
+    from jax.experimental.pallas import tpu as pltpu
+    S = x2d.shape[0]
+    T = jnp.int32(S * LANE)
+    shift_words = jnp.int32(shift_words)
+    shift_words = jax.lax.rem(jax.lax.rem(shift_words, T) + T, T)
+    q = jax.lax.div(shift_words, jnp.int32(LANE))
+    r = jax.lax.rem(shift_words, jnp.int32(LANE))
+    a = pltpu.roll(x2d, q, axis=0)
+    b = pltpu.roll(x2d, q + 1, axis=0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (S, LANE), 1)
+    return jnp.where(lane >= r, pltpu.roll(a, r, axis=1),
+                     pltpu.roll(b, r, axis=1))
+
+
+def _byte_roll(x2d, shift_bytes):
+    """Byte-granular circular roll of [S, 128] u32 words in flat little-
+    endian byte order: output byte j = input byte (j - shift) mod 4S·128.
+
+    Word roll for the multiple-of-4 part plus a sub-word splice of each word
+    with its flat predecessor for the remainder.
+    """
+    T4 = jnp.int32(x2d.shape[0] * LANE * 4)
+    shift_bytes = jnp.int32(shift_bytes)
+    shift_bytes = jax.lax.rem(jax.lax.rem(shift_bytes, T4) + T4, T4)
+    wshift = jax.lax.div(shift_bytes, jnp.int32(4))
+    rb = jax.lax.rem(shift_bytes, jnp.int32(4))
+    a = _flat_roll(x2d, wshift)          # bytes rolled by 4·wshift
+    prev = _flat_roll(x2d, wshift + 1)   # each word's flat predecessor
+    # little-endian: rolling bytes forward in memory by rb means each output
+    # word takes its own low bytes shifted up and the predecessor's high
+    # bytes shifted down.  Vector shifts by a traced amount do not legalize
+    # in Mosaic, so all four constant-shift variants are computed (cheap VPU
+    # ops) and selected by the scalar remainder.
+    # NOTE the package runs with jax_enable_x64; bare Python ints trace as
+    # i64 and Mosaic cannot legalize mixed-width vector ops, so every
+    # constant here is explicitly 32-bit.
+    variants = [a]
+    for k in (1, 2, 3):
+        variants.append((a << jnp.uint32(8 * k))
+                        | (prev >> jnp.uint32(32 - 8 * k)))
+    out = variants[3]
+    for k in (2, 1, 0):
+        out = jnp.where(rb == jnp.int32(k), variants[k], out)
+    return out
+
+
+def _byte_keep_mask(word_pos4, start_b, end_b):
+    """u32 mask per word for flat byte positions in [start_b, end_b).
+
+    ``word_pos4``: [S, 128] i32, flat byte position of each word's byte 0.
+    """
+    # built in int32 and bitcast at the end: Mosaic's bool→uint32 convert
+    # recurses in its lowering helper, int32 selects are fine
+    m = jnp.zeros(word_pos4.shape, jnp.int32)
+    for j in range(4):
+        pj = word_pos4 + jnp.int32(j)
+        inside = (pj >= start_b) & (pj < end_b)
+        v = 0xFF << (8 * j)
+        v = v - (1 << 32) if v >= (1 << 31) else v   # as signed i32 bits
+        m = m | jnp.where(inside, jnp.int32(v), jnp.int32(0))
+    return jax.lax.bitcast_convert_type(m, jnp.uint32)
+
+
+# ---------------------------------------------------------------------------
+# pack: dense [n, M] → flat
+# ---------------------------------------------------------------------------
+
+def _pack_geometry(offs: np.ndarray, n: int, B: int):
+    total = int(offs[-1])
+    nblocks = max(1, -(-total // B))
+    r_begin = np.searchsorted(offs, np.arange(nblocks, dtype=np.int64) * B,
+                              side="right") - 1
+    r_begin = np.maximum(r_begin, 0)
+    r_end = np.searchsorted(
+        offs, np.minimum(np.arange(1, nblocks + 1, dtype=np.int64) * B, total),
+        side="left")
+    r0 = (r_begin // 8) * 8
+    NR = int(np.max(r_end - r0)) if n else 8
+    NR = _round_up(max(NR, 8), 8)
+    return total, nblocks, r_begin.astype(np.int32), r_end, r0.astype(np.int32), NR
+
+
+def pack_rows(dense: jnp.ndarray, row_offsets: np.ndarray,
+              block_bytes: int = 8192) -> jnp.ndarray:
+    """Pack zero-padded dense rows into a flat byte buffer on TPU.
+
+    ``dense``: u8 [n, M]; row r's bytes [0, size_r) are its payload (the
+    rest must be zero).  ``row_offsets``: HOST int array [n+1], byte offsets
+    into the output; ``size_r = offsets[r+1] - offsets[r] ≤ M``.  Offsets
+    and sizes are byte-granular (no alignment requirement).
+
+    Runs under ``jax.enable_x64(False)``: the package globally enables x64
+    (int64 columns), but PrefetchScalarGridSpec and ``pltpu.roll`` fail to
+    legalize under x64, and everything here is 32-bit anyway.
+    """
+    with jax.enable_x64(False):
+        return _pack_rows_impl(dense, row_offsets, block_bytes)
+
+
+def _pack_rows_impl(dense, row_offsets, block_bytes):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, M = dense.shape
+    offs = np.asarray(row_offsets, dtype=np.int64)
+    total = int(offs[-1])
+    if total == 0 or n == 0:
+        return jnp.zeros((0,), jnp.uint8)
+    B = block_bytes
+    assert B % _WINDOW_ALIGN == 0
+    Mp = max(_WINDOW_ALIGN, _round_up(M, _WINDOW_ALIGN))
+    if Mp > B:
+        B = _round_up(Mp, _WINDOW_ALIGN)
+    Mw = Mp // 4
+    MwS = Mw // LANE
+    Bw = B // 4
+    SB = Bw // LANE
+
+    total_, nblocks, rb, r_end, r0, NR = _pack_geometry(offs, n, B)
+    # bucket every data-dependent static so nearby geometries share one
+    # compiled kernel (each unique static tuple costs a full Mosaic compile)
+    NR = _pow2_bucket(NR, 8)
+    KOFF = _pow2_bucket(NR // LANE + 2, 2)
+    nblocks_q = _soft_bucket(nblocks, 1)
+    pad_blk = nblocks_q - nblocks
+    rb = np.pad(rb, (0, pad_blk))
+    r0 = np.pad(r0, (0, pad_blk))
+    nr = np.pad((r_end - rb[:nblocks]).astype(np.int32), (0, pad_blk))
+    nblocks = nblocks_q
+
+    n_pad = _soft_bucket(_round_up(n, 8) + NR)
+    dense_pad = jnp.pad(dense, ((0, n_pad - n), (0, Mp - M)))
+    dense32 = u8_to_u32(dense_pad.reshape(-1)).reshape(n_pad, MwS, LANE)
+
+    offs32 = offs.astype(np.int32)
+    offs_rows = _soft_bucket(-(-(n_pad + 1) // LANE) + KOFF + 1)
+    offs2d = jnp.asarray(
+        np.pad(offs32, (0, offs_rows * LANE - offs32.shape[0]))
+        .reshape(offs_rows, LANE))
+
+    def kernel(r0_ref, rb_ref, nr_ref, offs_hbm, dense_hbm, out_ref,
+               scratch, soffs, sems):
+        b = pl.program_id(0)
+        row0 = r0_ref[b]
+        dma = pltpu.make_async_copy(dense_hbm.at[pl.ds(row0, NR)], scratch,
+                                    sems.at[0])
+        dma.start()
+        orow0 = row0 // LANE
+        for k in range(KOFF):
+            pltpu.make_async_copy(offs_hbm.at[orow0 + k], soffs.at[k],
+                                  sems.at[1 + k]).start()
+        dma.wait()
+        for k in range(KOFF):
+            pltpu.make_async_copy(offs_hbm.at[orow0 + k], soffs.at[k],
+                                  sems.at[1 + k]).wait()
+
+        blk_start = b * B
+        pos4 = ((jax.lax.broadcasted_iota(jnp.int32, (SB, LANE), 0) * LANE
+                 + jax.lax.broadcasted_iota(jnp.int32, (SB, LANE), 1)) * 4)
+
+        def body(i, acc):
+            r = rb_ref[b] + i
+            lr = r - row0
+            o_lo = soffs[(r // LANE) - orow0, r % LANE]
+            o_hi = soffs[((r + 1) // LANE) - orow0, (r + 1) % LANE]
+            rowvec = scratch[lr]                 # [MwS, LANE] u32
+            if SB > MwS:
+                ext = jnp.concatenate(
+                    [rowvec, jnp.zeros((SB - MwS, LANE), jnp.uint32)], axis=0)
+            else:
+                ext = rowvec[:SB]
+            p = o_lo - blk_start                 # byte position, may be < 0
+            rolled = _byte_roll(ext, p)
+            keep = _byte_keep_mask(pos4, p, p + (o_hi - o_lo))
+            return acc | (rolled & keep)
+
+        acc = jax.lax.fori_loop(0, nr_ref[b],
+                                body, jnp.zeros((SB, LANE), jnp.uint32))
+        out_ref[...] = acc[None]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(nblocks,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((1, SB, LANE), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((NR, MwS, LANE), jnp.uint32),
+                        pltpu.SMEM((KOFF, LANE), jnp.int32),
+                        pltpu.SemaphoreType.DMA((1 + KOFF,))])
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((nblocks, SB, LANE), jnp.uint32),
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(jnp.asarray(r0), jnp.asarray(rb), jnp.asarray(nr), offs2d, dense32)
+    return u32_to_u8(out.reshape(-1))[:total]
+
+
+# ---------------------------------------------------------------------------
+# unpack: flat → dense [n, M]
+# ---------------------------------------------------------------------------
+
+def unpack_rows(flat: jnp.ndarray, row_offsets: np.ndarray, M: int,
+                rows_per_block: int = 8) -> jnp.ndarray:
+    """Inverse of :func:`pack_rows`: split a flat byte buffer into
+    zero-padded dense rows u8 [n, M].  Byte-granular offsets.
+
+    Runs under ``jax.enable_x64(False)`` — see :func:`pack_rows`."""
+    with jax.enable_x64(False):
+        return _unpack_rows_impl(flat, row_offsets, M, rows_per_block)
+
+
+def _unpack_rows_impl(flat, row_offsets, M, rows_per_block):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    offs = np.asarray(row_offsets, dtype=np.int64)
+    n = offs.shape[0] - 1
+    total = int(offs[-1])
+    if n == 0:
+        return jnp.zeros((0, M), jnp.uint8)
+    RB = rows_per_block
+    Mp = max(_WINDOW_ALIGN, _round_up(M, _WINDOW_ALIGN))
+    Mw = Mp // 4
+    MwS = Mw // LANE
+    nblocks = _soft_bucket(-(-n // RB), 1)    # bucketed: shared compiles
+    n_pad = nblocks * RB
+    KOFF = _pow2_bucket(RB // LANE + 2, 2)
+
+    offs_pad = np.pad(offs, (0, n_pad + 1 - offs.shape[0]), mode="edge")
+    start_word_row = ((offs_pad[np.arange(nblocks) * RB] // 4) // LANE
+                      ).astype(np.int32)
+    # window sized from the DATA: rows may be larger than M (extracting a
+    # prefix, e.g. the fixed region of full JCUDF rows), so each block's
+    # staged window must span its rows' full strides, not RB*M
+    spans = (offs_pad[np.minimum(np.arange(1, nblocks + 1) * RB, n_pad)]
+             - start_word_row.astype(np.int64) * (LANE * 4))
+    KS = _pow2_bucket(int(spans.max(initial=1)) // (LANE * 4) + 2, 8)
+    KS = max(KS, _round_up(MwS, 8))
+    if KS * LANE * 4 > (1 << 21):
+        raise ValueError("unpack_rows: row span exceeds VMEM window budget")
+    flat_rows = _soft_bucket(-(-total // (LANE * 4)) + KS)
+    flat_pad = jnp.pad(flat, (0, flat_rows * LANE * 4 - total))
+    flat32 = u8_to_u32(flat_pad).reshape(flat_rows, LANE)
+
+    offs32 = offs_pad.astype(np.int32)
+    offs_rows = _soft_bucket(-(-(n_pad + 1) // LANE) + KOFF + 1)
+    offs2d = jnp.asarray(
+        np.pad(offs32, (0, offs_rows * LANE - offs32.shape[0]))
+        .reshape(offs_rows, LANE))
+
+    def kernel(sw_ref, offs_hbm, flat_hbm, out_ref, win, soffs, sems):
+        b = pl.program_id(0)
+        dma = pltpu.make_async_copy(flat_hbm.at[pl.ds(sw_ref[b], KS)], win,
+                                    sems.at[0])
+        dma.start()
+        orow0 = (b * RB) // LANE
+        for k in range(KOFF):
+            pltpu.make_async_copy(offs_hbm.at[orow0 + k], soffs.at[k],
+                                  sems.at[1 + k]).start()
+        dma.wait()
+        for k in range(KOFF):
+            pltpu.make_async_copy(offs_hbm.at[orow0 + k], soffs.at[k],
+                                  sems.at[1 + k]).wait()
+        w = win[...]
+        pos4 = ((jax.lax.broadcasted_iota(jnp.int32, (MwS, LANE), 0) * LANE
+                 + jax.lax.broadcasted_iota(jnp.int32, (MwS, LANE), 1)) * 4)
+        base_b = sw_ref[b] * LANE * 4
+        for lr in range(RB):
+            r = b * RB + lr
+            o_lo = soffs[(r // LANE) - orow0, r % LANE]
+            o_hi = soffs[((r + 1) // LANE) - orow0, (r + 1) % LANE]
+            q = o_lo - base_b                    # byte pos within window
+            rolled = _byte_roll(w, -q)[:MwS]
+            keep = _byte_keep_mask(pos4, 0, o_hi - o_lo)
+            out_ref[0, lr] = rolled & keep
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblocks,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((1, RB, MwS, LANE), lambda b, *_: (b, 0, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((KS, LANE), jnp.uint32),
+                        pltpu.SMEM((KOFF, LANE), jnp.int32),
+                        pltpu.SemaphoreType.DMA((1 + KOFF,))])
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((nblocks, RB, MwS, LANE), jnp.uint32),
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(jnp.asarray(start_word_row), offs2d, flat32)
+    dense = u32_to_u8(out.reshape(-1)).reshape(n_pad, Mp)
+    return dense[:n, :M]
+
+
+# ---------------------------------------------------------------------------
+# segmented_copy: arbitrary monotone byte segments, src_flat → dst_flat
+# ---------------------------------------------------------------------------
+
+def segmented_copy(src: jnp.ndarray, src_offs: np.ndarray,
+                   dst_offs: np.ndarray, sizes: np.ndarray,
+                   dst_size: int, block_bytes: int = 8192) -> jnp.ndarray:
+    """Copy n byte segments ``src[src_offs[k] : +sizes[k]] →
+    dst[dst_offs[k] : +sizes[k]]`` on TPU.  Bytes of ``dst`` not covered by
+    any segment are zero.
+
+    Requirements: ``dst_offs`` strictly non-decreasing with non-overlapping
+    [dst_offs[k], +sizes[k]) ranges, and ``src_offs`` non-decreasing (so
+    each destination block's sources fit one contiguous staged window —
+    true for every use in this package: JCUDF row pack/unpack, per-column
+    string extraction, fixed-region extraction).  Byte-granular, no
+    alignment requirements.  Runs under ``jax.enable_x64(False)`` — see
+    :func:`pack_rows`.
+    """
+    with jax.enable_x64(False):
+        return _segmented_copy_impl(src, src_offs, dst_offs, sizes,
+                                    dst_size, block_bytes)
+
+
+def _segmented_copy_impl(src, src_offs, dst_offs, sizes, dst_size, B):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    src_offs = np.asarray(src_offs, dtype=np.int64)
+    dst_offs = np.asarray(dst_offs, dtype=np.int64)
+    sizes = np.asarray(sizes, dtype=np.int64)
+    n = sizes.shape[0]
+    if dst_size == 0:
+        return jnp.zeros((0,), jnp.uint8)
+    if n == 0:
+        return jnp.zeros((dst_size,), jnp.uint8)
+    if int(sizes.max(initial=0)) > B:
+        # ValueError so copy_segments degrades to the XLA fallback (an
+        # assert would escape that handler and vanish under python -O)
+        raise ValueError("segmented_copy: segment larger than block")
+
+    nblocks = _soft_bucket(-(-dst_size // B), 1)   # bucketed: shared compiles
+    Bw = B // 4
+    SB = Bw // LANE
+    dst_end = dst_offs + sizes
+    # segments intersecting each dst block (blocks past dst_size get ns=0)
+    s_begin = np.searchsorted(dst_end, np.arange(nblocks, dtype=np.int64) * B,
+                              side="right")
+    s_end = np.searchsorted(dst_offs,
+                            np.minimum(np.arange(1, nblocks + 1,
+                                                 dtype=np.int64) * B,
+                                       dst_size), side="left")
+    s_begin = np.minimum(s_begin, np.maximum(s_end - 1, 0))
+    ns = np.maximum(s_end - s_begin, 0).astype(np.int32)
+
+    # staged source window per block (512B-aligned)
+    w_begin = src_offs[np.minimum(s_begin, n - 1)]
+    w0 = (w_begin // _WINDOW_ALIGN) * _WINDOW_ALIGN
+    last = np.maximum(s_end - 1, 0)
+    span = (src_offs[last] + sizes[last]) - w0
+    span = np.where(ns > 0, span, 1)
+    KSw = _pow2_bucket(int(np.max(span)) // 4 // LANE + 2, 8)
+    KSw = max(KSw, SB)        # rolled window must cover one output block
+    if KSw * LANE * 4 > (1 << 21):
+        raise ValueError("segmented_copy: source window exceeds VMEM budget")
+
+    S = int(src.shape[0])
+    src_rows = _soft_bucket(-(-S // (LANE * 4)) + KSw)
+    src_pad = jnp.pad(src, (0, src_rows * LANE * 4 - S))
+    src32 = u8_to_u32(src_pad).reshape(src_rows, LANE)
+
+    # max segments per block bounds the meta staging
+    NSMAX = int(np.max(ns)) if nblocks else 1
+    KMETA = _pow2_bucket(NSMAX // LANE + 2, 2)
+
+    # per-segment metadata staged from HBM: src_off, dst_off, size (rows
+    # sized so every staged window m0..m0+KMETA stays in bounds)
+    def _meta2d(a):
+        rows = _soft_bucket(-(-n // LANE) + KMETA + 1)
+        return jnp.asarray(np.pad(a.astype(np.int32), (0, rows * LANE - n))
+                           .reshape(rows, LANE))
+    srcm, dstm, szm = _meta2d(src_offs), _meta2d(dst_offs), _meta2d(sizes)
+
+    sw = (w0 // 4 // LANE).astype(np.int32)      # window start (sublane rows)
+    sb32 = s_begin.astype(np.int32)
+
+    def kernel(sw_ref, sb_ref, ns_ref, srcm_hbm, dstm_hbm, szm_hbm, src_hbm,
+               out_ref, win, ssrc, sdst, ssz, sems):
+        b = pl.program_id(0)
+        dma = pltpu.make_async_copy(src_hbm.at[pl.ds(sw_ref[b], KSw)], win,
+                                    sems.at[0])
+        dma.start()
+        m0 = sb_ref[b] // LANE
+        for k in range(KMETA):
+            pltpu.make_async_copy(srcm_hbm.at[m0 + k], ssrc.at[k],
+                                  sems.at[1 + 3 * k]).start()
+            pltpu.make_async_copy(dstm_hbm.at[m0 + k], sdst.at[k],
+                                  sems.at[2 + 3 * k]).start()
+            pltpu.make_async_copy(szm_hbm.at[m0 + k], ssz.at[k],
+                                  sems.at[3 + 3 * k]).start()
+        dma.wait()
+        for k in range(KMETA):
+            pltpu.make_async_copy(srcm_hbm.at[m0 + k], ssrc.at[k],
+                                  sems.at[1 + 3 * k]).wait()
+            pltpu.make_async_copy(dstm_hbm.at[m0 + k], sdst.at[k],
+                                  sems.at[2 + 3 * k]).wait()
+            pltpu.make_async_copy(szm_hbm.at[m0 + k], ssz.at[k],
+                                  sems.at[3 + 3 * k]).wait()
+
+        w = win[...]
+        blk_start = b * B
+        base_b = sw_ref[b] * jnp.int32(LANE * 4)
+        pos4 = ((jax.lax.broadcasted_iota(jnp.int32, (SB, LANE), 0)
+                 * jnp.int32(LANE)
+                 + jax.lax.broadcasted_iota(jnp.int32, (SB, LANE), 1))
+                * jnp.int32(4))
+
+        def body(i, acc):
+            s = sb_ref[b] + i
+            row = (s // LANE) - m0
+            col = s % LANE
+            so = ssrc[row, col]
+            do = sdst[row, col]
+            L = ssz[row, col]
+            a = so - base_b                      # src byte pos in window
+            p = do - blk_start                   # dst byte pos in block
+            rolled = _byte_roll(w, p - a)[:SB]
+            keep = _byte_keep_mask(pos4, p, p + L)
+            return acc | (rolled & keep)
+
+        acc = jax.lax.fori_loop(0, ns_ref[b], body,
+                                jnp.zeros((SB, LANE), jnp.uint32))
+        out_ref[...] = acc[None]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(nblocks,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+        out_specs=pl.BlockSpec((1, SB, LANE), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((KSw, LANE), jnp.uint32),
+                        pltpu.SMEM((KMETA, LANE), jnp.int32),
+                        pltpu.SMEM((KMETA, LANE), jnp.int32),
+                        pltpu.SMEM((KMETA, LANE), jnp.int32),
+                        pltpu.SemaphoreType.DMA((1 + 3 * KMETA,))])
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((nblocks, SB, LANE), jnp.uint32),
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(jnp.asarray(sw), jnp.asarray(sb32), jnp.asarray(ns),
+      srcm, dstm, szm, src32)
+    return u32_to_u8(out.reshape(-1))[:dst_size]
+
+
+def segmented_copy_xla(src, src_offs, dst_offs, sizes, dst_size):
+    """Gather-formulated fallback for CPU backends."""
+    src_offs = np.asarray(src_offs, dtype=np.int64)
+    dst_offs = np.asarray(dst_offs, dtype=np.int64)
+    sizes = np.asarray(sizes, dtype=np.int64)
+    if dst_size == 0 or sizes.shape[0] == 0:
+        return jnp.zeros((dst_size,), jnp.uint8)
+    # segment of each dst byte (host-side geometry; offsets are host arrays)
+    dst_end = dst_offs + sizes
+    o = jnp.arange(dst_size, dtype=jnp.int32)
+    seg = jnp.asarray(
+        np.searchsorted(dst_end, np.arange(dst_size), side="right")
+        .astype(np.int32))
+    seg = jnp.clip(seg, 0, sizes.shape[0] - 1)
+    so = jnp.asarray(src_offs.astype(np.int32))[seg]
+    do = jnp.asarray(dst_offs.astype(np.int32))[seg]
+    sz = jnp.asarray(sizes.astype(np.int32))[seg]
+    within = o - do
+    keep = (within >= 0) & (within < sz)
+    if src.shape[0] == 0:
+        return jnp.zeros((dst_size,), jnp.uint8)
+    vals = src[jnp.clip(so + within, 0, src.shape[0] - 1)]
+    return jnp.where(keep, vals, 0)
+
+
+def copy_segments(src, src_offs, dst_offs, sizes, dst_size):
+    """Dispatching segmented copy: DMA kernel on TPU, XLA gather elsewhere."""
+    if dma_supported():
+        try:
+            return segmented_copy(src, src_offs, dst_offs, sizes, dst_size)
+        except ValueError:   # window exceeds VMEM budget — degrade
+            pass
+    return segmented_copy_xla(src, src_offs, dst_offs, sizes, dst_size)
+
+
+# ---------------------------------------------------------------------------
+# XLA fallback (CPU backends / SRJT_RAGGED_DMA=0): the gather formulation.
+# Correct everywhere; slow on TPU (scalarized gather) — the kernels above
+# exist precisely because of that.
+# ---------------------------------------------------------------------------
+
+def _segment_of(starts: jnp.ndarray, total: int) -> jnp.ndarray:
+    markers = jnp.zeros((total,), dtype=jnp.int32).at[starts[1:-1]].add(1)
+    return jnp.cumsum(markers)
+
+
+def pack_rows_xla(dense: jnp.ndarray, row_offsets: np.ndarray) -> jnp.ndarray:
+    n, M = dense.shape
+    offs = np.asarray(row_offsets, dtype=np.int64)
+    total = int(offs[-1])
+    if total == 0 or n == 0:
+        return jnp.zeros((0,), jnp.uint8)
+    offs_dev = jnp.asarray(offs.astype(np.int32))
+    row_of = _segment_of(offs_dev, total)
+    w = jnp.arange(total, dtype=jnp.int32) - offs_dev[row_of]
+    return dense.reshape(-1)[row_of * M + w]
+
+
+def unpack_rows_xla(flat: jnp.ndarray, row_offsets: np.ndarray,
+                    M: int) -> jnp.ndarray:
+    offs = np.asarray(row_offsets, dtype=np.int64)
+    n = offs.shape[0] - 1
+    if n == 0:
+        return jnp.zeros((0, M), jnp.uint8)
+    offs_dev = jnp.asarray(offs.astype(np.int32))
+    sizes = offs_dev[1:] - offs_dev[:-1]
+    j = jnp.arange(M, dtype=jnp.int32)
+    idx = offs_dev[:-1, None] + j[None, :]
+    keep = j[None, :] < sizes[:, None]
+    if flat.shape[0] == 0:
+        return jnp.zeros((n, M), jnp.uint8)
+    vals = flat[jnp.clip(idx, 0, flat.shape[0] - 1)]
+    return jnp.where(keep, vals, 0)
+
+
+def pack(dense: jnp.ndarray, row_offsets: np.ndarray) -> jnp.ndarray:
+    """Dispatching pack: DMA kernels on TPU, XLA gather elsewhere."""
+    if dma_supported():
+        return pack_rows(dense, row_offsets)
+    return pack_rows_xla(dense, row_offsets)
+
+
+def unpack(flat: jnp.ndarray, row_offsets: np.ndarray, M: int) -> jnp.ndarray:
+    """Dispatching unpack: DMA kernels on TPU, XLA gather elsewhere."""
+    if dma_supported():
+        try:
+            return unpack_rows(flat, row_offsets, M)
+        except ValueError:   # row span exceeds VMEM window — degrade
+            pass
+    return unpack_rows_xla(flat, row_offsets, M)

@@ -123,3 +123,94 @@ def test_bad_config_rejected(tmp_path):
     p.write_text(json.dumps({"sites": {"x": {"injectionType": "nope"}}}))
     with pytest.raises(ValueError, match="injectionType"):
         inj.load_config(str(p))
+
+
+# ---- JAX-boundary shim + retry/quarantine contract (faultinj.cu:125-131,
+# faultinj/README.md:3-16) --------------------------------------------------
+
+from spark_rapids_jni_tpu.faultinj import jax_shim
+from spark_rapids_jni_tpu.faultinj.resilience import (DeviceQuarantined,
+                                                      ResilientExecutor)
+
+
+@pytest.fixture
+def shim():
+    sites = jax_shim.install()
+    yield sites
+    jax_shim.uninstall()
+
+
+def _device_work():
+    import jax.numpy as jnp
+    # fresh data each call so the computation actually dispatches
+    x = jnp.asarray(np.random.default_rng(0).integers(0, 9, 64))
+    return int(jnp.sum(x))
+
+
+def test_shim_intercepts_execute(tmp_path, shim):
+    assert "jax.execute" in shim
+    faultinj.enable(write_cfg(tmp_path, {
+        "seed": 1,
+        "sites": {"jax.execute": {"percent": 100,
+                                  "interceptionCount": 1,
+                                  "injectionType": "device_error"}}}))
+    with pytest.raises(InjectedDeviceError):
+        _device_work()
+    # budget spent — next call reaches the device
+    assert _device_work() == int(np.sum(
+        np.random.default_rng(0).integers(0, 9, 64)))
+
+
+def test_executor_retries_transient_then_succeeds(tmp_path, shim):
+    faultinj.enable(write_cfg(tmp_path, {
+        "seed": 1,
+        "sites": {"jax.execute": {"percent": 100,
+                                  "interceptionCount": 2,
+                                  "injectionType": "oom"}}}))
+    ex = ResilientExecutor(max_retries=3)
+    assert ex.submit(_device_work) == _device_work()
+    assert ex.retry_count == 2
+    assert not ex.quarantined
+
+
+def test_executor_quarantines_on_fatal(tmp_path, shim):
+    faultinj.enable(write_cfg(tmp_path, {
+        "seed": 1,
+        "sites": {"jax.execute": {"percent": 100,
+                                  "interceptionCount": 1,
+                                  "injectionType": "device_error"}}}))
+    ex = ResilientExecutor(max_retries=3)
+    with pytest.raises(DeviceQuarantined):
+        ex.submit(_device_work)
+    assert ex.quarantined
+    # quarantined executor fails fast without touching the device
+    with pytest.raises(DeviceQuarantined):
+        ex.submit(_device_work)
+    assert ex.fatal_count == 1
+
+
+def test_shim_device_conversion_retry_end_to_end(tmp_path, shim):
+    """A real device call (JCUDF conversion) failed by the shim is retried
+    by the executor and completes — the reference's tier-3 contract."""
+    faultinj.enable(write_cfg(tmp_path, {
+        "seed": 1,
+        "sites": {"jax.execute": {"percent": 100,
+                                  "interceptionCount": 1,
+                                  "injectionType": "oom"}}}))
+    ex = ResilientExecutor(max_retries=2)
+
+    def work():
+        batches = convert_to_rows(small_table())
+        return int(np.asarray(batches[0].data).sum())
+
+    assert ex.submit(work) == work()
+    assert ex.retry_count >= 1
+
+
+def test_shim_uninstall_restores(shim):
+    jax_shim.uninstall()
+    assert not jax_shim.installed()
+    # no interception after uninstall even with an aggressive config
+    inj = faultinj.get_injector()
+    inj._rules = {}
+    assert _device_work() >= 0

@@ -141,3 +141,40 @@ def test_q6_matches_pandas():
     expect = float((df.l_extendedprice[m] * df.l_discount[m]).sum())
     assert matched == int(m.sum())
     np.testing.assert_allclose(revenue, expect, rtol=1e-9)
+
+
+# ---- snappy (pure-python decoder; pyarrow's bundled snappy is the writer
+# oracle — the image has no python-snappy) ----------------------------------
+
+def test_snappy_pages_roundtrip():
+    n = 20000
+    t = pa.table({
+        "i64": pa.array(RNG.integers(-10**9, 10**9, n, dtype=np.int64)),
+        "f64": pa.array(np.repeat(RNG.standard_normal(n // 100), 100)),
+    })
+    raw = write(t, compression="SNAPPY", use_dictionary=False)
+    got = decode.read_table(raw)
+    np.testing.assert_array_equal(got[0].to_numpy(), t["i64"].to_numpy())
+    np.testing.assert_array_equal(got[1].to_numpy(), t["f64"].to_numpy())
+
+
+def test_snappy_highly_compressible():
+    """Runs/RLE-ish data exercises overlapping back-references."""
+    n = 50000
+    vals = np.zeros(n, dtype=np.int64)
+    vals[::97] = np.arange(len(vals[::97]))
+    t = pa.table({"v": pa.array(vals)})
+    raw = write(t, compression="SNAPPY", use_dictionary=False)
+    got = decode.read_table(raw)
+    np.testing.assert_array_equal(got[0].to_numpy(), vals)
+
+
+def test_snappy_decoder_rejects_corrupt():
+    from spark_rapids_jni_tpu.parquet import snappy as sn
+    with pytest.raises(sn.SnappyError):
+        sn.decompress(b"\xff\xff\xff\xff\xff\xff")   # runaway varint
+    with pytest.raises(sn.SnappyError):
+        sn.decompress(b"\x10\x04abc")                # literal overrun
+    # copy before start of output
+    with pytest.raises(sn.SnappyError):
+        sn.decompress(bytes([0x05, 0x00 | 0x00, ord("a"), 0x09, 0x10]))

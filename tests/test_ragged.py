@@ -1,0 +1,77 @@
+"""Tests for the ragged segmented-copy engine (rowconv/ragged.py).
+
+The pytest session pins the CPU backend (tests/conftest.py), so these cover
+the XLA fallback formulations — the DMA kernels themselves are validated on
+the real chip by ``tools/tpu_check.py``, which byte-compares them against
+the same oracles and writes ``PALLAS_TPU_CHECK.json``.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.rowconv import ragged
+
+
+def _random_ragged(rng, n, M, aligned=False):
+    if aligned:
+        sizes = rng.integers(1, M // 8 + 1, n) * 8
+    else:
+        sizes = rng.integers(0, M + 1, n)
+    offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(sizes, out=offs[1:])
+    dense = np.zeros((n, M), dtype=np.uint8)
+    for r in range(n):
+        dense[r, :sizes[r]] = rng.integers(1, 256, sizes[r])
+    flat = (np.concatenate([dense[r, :sizes[r]] for r in range(n)])
+            if offs[-1] else np.zeros(0, np.uint8))
+    return dense, offs, flat
+
+
+@pytest.mark.parametrize("n,M,aligned", [(64, 48, True), (301, 64, False),
+                                         (257, 33, False)])
+def test_pack_unpack_xla_roundtrip(n, M, aligned):
+    rng = np.random.default_rng(n)
+    dense, offs, flat = _random_ragged(rng, n, M, aligned)
+    got_flat = np.asarray(ragged.pack_rows_xla(jnp.asarray(dense), offs))
+    np.testing.assert_array_equal(got_flat, flat)
+    got_dense = np.asarray(ragged.unpack_rows_xla(jnp.asarray(flat), offs, M))
+    np.testing.assert_array_equal(got_dense, dense)
+
+
+def test_segmented_copy_xla_gappy():
+    rng = np.random.default_rng(7)
+    S, n = 50000, 300
+    src = rng.integers(1, 256, S).astype(np.uint8)
+    sizes = rng.integers(0, 60, n)
+    gaps = rng.integers(0, 50, n)
+    src_offs = np.cumsum(sizes + gaps) - (sizes + gaps)
+    dst_offs = np.concatenate([[0], np.cumsum(sizes)])[:-1]
+    total = int(sizes.sum())
+    expect = np.zeros(total, np.uint8)
+    for k in range(n):
+        expect[dst_offs[k]:dst_offs[k] + sizes[k]] = \
+            src[src_offs[k]:src_offs[k] + sizes[k]]
+    got = np.asarray(ragged.segmented_copy_xla(
+        jnp.asarray(src), src_offs, dst_offs, sizes, total))
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_dispatchers_use_fallback_on_cpu():
+    assert not ragged.dma_supported()
+    rng = np.random.default_rng(1)
+    dense, offs, flat = _random_ragged(rng, 40, 64)
+    np.testing.assert_array_equal(
+        np.asarray(ragged.pack(jnp.asarray(dense), offs)), flat)
+    np.testing.assert_array_equal(
+        np.asarray(ragged.unpack(jnp.asarray(flat), offs, 64)), dense)
+
+
+def test_u8_u32_wide_helpers():
+    rng = np.random.default_rng(2)
+    x = rng.integers(0, 256, 4 * 1024).astype(np.uint8)
+    w = np.asarray(ragged.u8_to_u32(jnp.asarray(x)))
+    np.testing.assert_array_equal(w, x.view(np.uint32))
+    back = np.asarray(ragged.u32_to_u8(jnp.asarray(w)))
+    np.testing.assert_array_equal(back, x)

@@ -1,0 +1,122 @@
+"""TPC-DS subset differential tests (BASELINE config #3): every query's
+result is compared against pandas executing the same plan over the same
+Snappy parquet bytes — join + groupby + string keys + decimals end-to-end
+through decode → ops → output."""
+
+import io
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from benchmarks import tpcds_data
+from spark_rapids_jni_tpu.models import tpcds
+
+
+@pytest.fixture(scope="module")
+def files():
+    return tpcds_data.generate(n_sales=40_000, n_items=500, seed=7)
+
+
+@pytest.fixture(scope="module")
+def dfs(files):
+    return {name: pd.read_parquet(io.BytesIO(raw))
+            for name, raw in files.items()}
+
+
+@pytest.fixture(scope="module")
+def tables(files):
+    return tpcds.load_tables(files)
+
+
+def _assert_result(out, expect_df, key_cols, val_specs):
+    """out: framework Table (keys..., aggs...); expect_df: pandas frame with
+    the same columns, unsorted."""
+    expect = expect_df.sort_values(key_cols).reset_index(drop=True)
+    assert out.num_rows == len(expect), (out.num_rows, len(expect))
+    for i, k in enumerate(key_cols):
+        got = (out[i].to_pylist() if out[i].dtype.id.name == "STRING"
+               else out[i].to_numpy().tolist())
+        assert got == expect[k].tolist(), k
+    for j, (name, kind) in enumerate(val_specs):
+        got = np.asarray(out[len(key_cols) + j].to_numpy(), dtype=np.float64)
+        if kind == "decimal2":
+            got = got / 100.0
+        np.testing.assert_allclose(got, expect[name].to_numpy(), rtol=1e-9)
+
+
+def test_q3(tables, dfs):
+    mid = int(dfs["item"].i_manufact_id.mode()[0])   # guaranteed present
+    out = tpcds.q3(tables, manufact_id=mid, moy=11)
+    ss, item, dd = dfs["store_sales"], dfs["item"], dfs["date_dim"]
+    j = (ss.merge(item[item.i_manufact_id == mid], left_on="ss_item_sk",
+                  right_on="i_item_sk")
+         .merge(dd[dd.d_moy == 11], left_on="ss_sold_date_sk",
+                right_on="d_date_sk"))
+    exp = (j.groupby(["d_year", "i_brand_id", "i_brand"], as_index=False)
+           ["ss_ext_sales_price"].sum())
+    _assert_result(out, exp, ["d_year", "i_brand_id", "i_brand"],
+                   [("ss_ext_sales_price", "float")])
+
+
+def test_q42(tables, dfs):
+    mid = int(dfs["item"].i_manager_id.mode()[0])
+    out = tpcds.q42(tables, manager_id=mid, year=2000, moy=11)
+    ss, item, dd = dfs["store_sales"], dfs["item"], dfs["date_dim"]
+    j = (ss.merge(item[item.i_manager_id == mid], left_on="ss_item_sk",
+                  right_on="i_item_sk")
+         .merge(dd[(dd.d_moy == 11) & (dd.d_year == 2000)],
+                left_on="ss_sold_date_sk", right_on="d_date_sk"))
+    exp = (j.groupby(["d_year", "i_category_id", "i_category"],
+                     as_index=False)["ss_ext_sales_price"].sum())
+    _assert_result(out, exp, ["d_year", "i_category_id", "i_category"],
+                   [("ss_ext_sales_price", "float")])
+
+
+def test_q52(tables, dfs):
+    out = tpcds.q52(tables, moy=12, year=2001)
+    ss, item, dd = dfs["store_sales"], dfs["item"], dfs["date_dim"]
+    j = (ss.merge(dd[(dd.d_moy == 12) & (dd.d_year == 2001)],
+                  left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(item, left_on="ss_item_sk", right_on="i_item_sk"))
+    exp = (j.groupby(["d_year", "i_brand_id", "i_brand"], as_index=False)
+           ["ss_ext_sales_price"].sum())
+    _assert_result(out, exp, ["d_year", "i_brand_id", "i_brand"],
+                   [("ss_ext_sales_price", "float")])
+
+
+def test_q55(tables, dfs):
+    mid = int(dfs["item"].i_manager_id.mode()[0])
+    out = tpcds.q55(tables, manager_id=mid)
+    ss, item = dfs["store_sales"], dfs["item"]
+    j = ss.merge(item[item.i_manager_id == mid], left_on="ss_item_sk",
+                 right_on="i_item_sk")
+    exp = (j.groupby(["i_brand_id", "i_brand"], as_index=False)
+           ["ss_ext_sales_price"].sum())
+    _assert_result(out, exp, ["i_brand_id", "i_brand"],
+                   [("ss_ext_sales_price", "float")])
+
+
+def test_q_state_rollup(tables, dfs):
+    out = tpcds.q_state_rollup(tables, state="TN")
+    ss, store = dfs["store_sales"], dfs["store"]
+    j = ss.merge(store[store.s_state == "TN"], left_on="ss_store_sk",
+                 right_on="s_store_sk")
+    exp = (j.groupby(["s_state"], as_index=False)
+           .agg(price=("ss_sales_price_cents", "sum"),
+                qmean=("ss_quantity", "mean"),
+                qcount=("ss_quantity", "count")))
+    exp["price"] = exp["price"] / 100.0   # decimal(…,2) dollars
+    _assert_result(out, exp, ["s_state"],
+                   [("price", "decimal2"), ("qmean", "float"),
+                    ("qcount", "float")])
+
+
+def test_run_all_smoke(files):
+    # spec-default parameters may select nothing at this mini scale — an
+    # empty result is a valid result (Spark returns empty, not an error)
+    results = tpcds.run_all(files)
+    assert set(results) == set(tpcds.QUERIES)
+    for name, t in results.items():
+        assert t.num_columns >= 2, name
+        assert t.num_rows >= 0, name

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""On-TPU validation sweep → PALLAS_TPU_CHECK.json.
+
+Interpret-mode tests (the CPU pytest suite) cannot catch Mosaic compile or
+miscompile issues, so once per round this script byte-compares, on the real
+chip:
+
+1. the ragged DMA engine (pack / unpack / segmented_copy) vs NumPy;
+2. the full string JCUDF transcode (DMA path) vs the scalar NumPy oracle
+   (``rowconv/reference.py``) across schema shapes;
+3. the opt-in Pallas fixed-width kernels (SRJT_PALLAS=1 path) vs the XLA
+   path across the schema matrix (the two documented Mosaic workarounds in
+   ``pallas_kernels.py`` make this non-optional).
+
+Usage: python tools/tpu_check.py [out.json]
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+import spark_rapids_jni_tpu as sr
+from spark_rapids_jni_tpu import Table, Column, convert_to_rows, convert_from_rows
+from spark_rapids_jni_tpu.rowconv import ragged, reference
+from spark_rapids_jni_tpu.rowconv import pallas_kernels as pk
+from spark_rapids_jni_tpu.rowconv.convert import _to_rows_fixed_impl
+from spark_rapids_jni_tpu.rowconv.layout import compute_row_layout
+
+RESULTS = {"backend": None, "checks": [], "ok": True}
+
+
+def record(name, ok, note=""):
+    RESULTS["checks"].append({"name": name, "ok": bool(ok), "note": note})
+    RESULTS["ok"] = RESULTS["ok"] and bool(ok)
+    print(f"  {'PASS' if ok else 'FAIL'} {name} {note}", flush=True)
+
+
+def check_ragged():
+    rng = np.random.default_rng(0)
+    for n, M, aligned in [(301, 64, False), (1000, 256, False),
+                          (777, 33, False), (4097, 300, True)]:
+        if aligned:
+            sizes = rng.integers(1, M // 8 + 1, n) * 8
+        else:
+            sizes = rng.integers(0, M + 1, n)
+        offs = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(sizes, out=offs[1:])
+        dense = np.zeros((n, M), dtype=np.uint8)
+        for r in range(n):
+            dense[r, :sizes[r]] = rng.integers(1, 256, sizes[r])
+        flat = (np.concatenate([dense[r, :sizes[r]] for r in range(n)])
+                if offs[-1] else np.zeros(0, np.uint8))
+        got = np.asarray(ragged.pack_rows(jnp.asarray(dense), offs))
+        record(f"ragged.pack n={n} M={M}", np.array_equal(got, flat))
+        got2 = np.asarray(ragged.unpack_rows(jnp.asarray(flat), offs, M))
+        record(f"ragged.unpack n={n} M={M}", np.array_equal(got2, dense))
+
+    # gappy segmented copy
+    S, n = 500000, 400
+    src = rng.integers(1, 256, S).astype(np.uint8)
+    sizes = rng.integers(0, 256, n)
+    gaps = rng.integers(0, 700, n)
+    src_offs = np.cumsum(sizes + gaps) - (sizes + gaps)
+    dst_offs = np.concatenate([[0], np.cumsum(sizes)])[:-1]
+    total = int(sizes.sum())
+    expect = np.zeros(total, np.uint8)
+    for k in range(n):
+        expect[dst_offs[k]:dst_offs[k] + sizes[k]] = \
+            src[src_offs[k]:src_offs[k] + sizes[k]]
+    got = np.asarray(ragged.segmented_copy(jnp.asarray(src), src_offs,
+                                           dst_offs, sizes, total))
+    record("ragged.segmented_copy gappy", np.array_equal(got, expect))
+
+
+def check_strings_transcode():
+    rng = np.random.default_rng(1)
+    words = ["", "a", "spark", "tpu-native kernels", "xy",
+             "longer string payload!", "ab\x00cd"]
+    for n, nulls in [(1000, None), (503, 7)]:
+        strs = [words[i] for i in rng.integers(0, len(words), n)]
+        if nulls:
+            strs = [None if i % nulls == 0 else s
+                    for i, s in enumerate(strs)]
+        t = Table([
+            Column.from_numpy(rng.integers(-100, 100, n).astype(np.int32)),
+            Column.strings_from_list(strs),
+            Column.from_numpy(rng.integers(0, 2**40, n).astype(np.int64)),
+            Column.strings_from_list(
+                [words[i] for i in rng.integers(0, len(words), n)]),
+        ])
+        b = convert_to_rows(t)
+        ob, _ = reference.to_rows_np(t)
+        record(f"strings to_rows oracle n={n} nulls={nulls}",
+               np.array_equal(np.asarray(b[0].data), ob))
+        back = convert_from_rows(b[0], t.schema)
+        ok = (back[1].to_pylist() == t[1].to_pylist()
+              and back[3].to_pylist() == t[3].to_pylist()
+              and np.array_equal(back[0].to_numpy(), t[0].to_numpy()))
+        record(f"strings roundtrip n={n} nulls={nulls}", ok)
+
+
+SCHEMAS = {
+    "int32_only": [sr.int32] * 3,
+    "mixed_words": [sr.int32, sr.int16, sr.int8],
+    "wide_mixed": [sr.int64, sr.int32, sr.int16, sr.int8, sr.float32,
+                   sr.bool8] * 2,
+    "bytes_only": [sr.int8] * 5,
+    "timestamps_decimals": [sr.timestamp_ms, sr.decimal32(-2),
+                            sr.decimal64(-4), sr.bool8],
+}
+
+
+def check_pallas_fixed():
+    rng = np.random.default_rng(2)
+    for name, schema in SCHEMAS.items():
+        layout = compute_row_layout(schema)
+        n = 4097
+        datas, valid_cols = [], []
+        for dt in schema:
+            if dt.storage.kind == "f":
+                datas.append(jnp.asarray(
+                    rng.standard_normal(n).astype(dt.storage)))
+            else:
+                info = np.iinfo(dt.storage)
+                datas.append(jnp.asarray(rng.integers(
+                    info.min // 2, info.max // 2, n, dtype=dt.storage)))
+            valid_cols.append(rng.random(n) < 0.8)
+        valid = jnp.asarray(np.stack(valid_cols, axis=1))
+        want = np.asarray(_to_rows_fixed_impl(layout, False,
+                                              tuple(datas), valid))
+        got = np.asarray(pk.to_rows_fixed(layout, tuple(datas), valid))
+        record(f"pallas fixed to_rows {name}", np.array_equal(got, want))
+        back, v2 = pk.from_rows_fixed(layout, jnp.asarray(want))
+        ok = all(np.array_equal(np.asarray(g), np.asarray(d))
+                 for g, d in zip(back, datas))
+        ok = ok and np.array_equal(np.asarray(v2), np.asarray(valid))
+        record(f"pallas fixed from_rows {name}", ok)
+
+
+def main():
+    t0 = time.time()
+    RESULTS["backend"] = jax.default_backend()
+    if RESULTS["backend"] != "tpu":
+        RESULTS["ok"] = False
+        RESULTS["error"] = "not running on a TPU backend"
+    else:
+        print("ragged engine:", flush=True)
+        check_ragged()
+        print("strings transcode:", flush=True)
+        check_strings_transcode()
+        print("pallas fixed kernels (opt-in path):", flush=True)
+        check_pallas_fixed()
+    RESULTS["seconds"] = round(time.time() - t0, 1)
+    out = sys.argv[1] if len(sys.argv) > 1 else "PALLAS_TPU_CHECK.json"
+    with open(out, "w") as f:
+        json.dump(RESULTS, f, indent=1)
+    print(json.dumps({"ok": RESULTS["ok"], "checks": len(RESULTS["checks"]),
+                      "seconds": RESULTS["seconds"]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
