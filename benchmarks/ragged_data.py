@@ -1,0 +1,24 @@
+"""Shared random ragged-data generator — the single oracle-input source for
+both the CPU fallback tests (tests/test_ragged.py) and the on-chip sweep
+(tools/tpu_check.py), so the two always exercise the same distributions."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def random_ragged(rng: np.random.Generator, n: int, M: int,
+                  aligned: bool = False):
+    """Returns (dense u8 [n, M] zero-padded, offsets int64 [n+1], flat)."""
+    if aligned:
+        sizes = rng.integers(1, M // 8 + 1, n) * 8
+    else:
+        sizes = rng.integers(0, M + 1, n)
+    offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(sizes, out=offs[1:])
+    dense = np.zeros((n, M), dtype=np.uint8)
+    for r in range(n):
+        dense[r, :sizes[r]] = rng.integers(1, 256, sizes[r])
+    flat = (np.concatenate([dense[r, :sizes[r]] for r in range(n)])
+            if offs[-1] else np.zeros(0, np.uint8))
+    return dense, offs, flat

@@ -64,36 +64,36 @@ def install() -> list[str]:
         if _PATCHED:
             return list(_PATCHED)
         import jax
-        jax.clear_caches()
-
+        # resolve EVERY private seam before patching ANY: these move
+        # between JAX releases, and a partial install that fails midway
+        # would leave earlier shims stuck (retries short-circuit on the
+        # non-empty _PATCHED)
         import jax._src.compiler as _compiler
+        from jax._src.interpreters import pxla as _pxla
+        import jax._src.dispatch as _dispatch
         orig_compile = _compiler.backend_compile
+        orig_call = _pxla.ExecuteReplicated.__call__
+        orig_put = _dispatch.device_put_p.impl
+
+        jax.clear_caches()
 
         @functools.wraps(orig_compile)
         def compile_shim(*a, **k):
             return _intercept("jax.compile", orig_compile, *a, **k)
 
-        _compiler.backend_compile = compile_shim
-        _PATCHED["jax.compile"] = (_compiler, "backend_compile", orig_compile)
-
-        from jax._src.interpreters import pxla as _pxla
-        orig_call = _pxla.ExecuteReplicated.__call__
-
         @functools.wraps(orig_call)
         def call_shim(self, *a, **k):
             return _intercept("jax.execute", orig_call, self, *a, **k)
-
-        _pxla.ExecuteReplicated.__call__ = call_shim
-        _PATCHED["jax.execute"] = (_pxla.ExecuteReplicated, "__call__",
-                                   orig_call)
-
-        import jax._src.dispatch as _dispatch
-        orig_put = _dispatch.device_put_p.impl
 
         @functools.wraps(orig_put)
         def put_shim(*a, **k):
             return _intercept("jax.device_put", orig_put, *a, **k)
 
+        _compiler.backend_compile = compile_shim
+        _PATCHED["jax.compile"] = (_compiler, "backend_compile", orig_compile)
+        _pxla.ExecuteReplicated.__call__ = call_shim
+        _PATCHED["jax.execute"] = (_pxla.ExecuteReplicated, "__call__",
+                                   orig_call)
         _dispatch.device_put_p.impl = put_shim
         _PATCHED["jax.device_put"] = (_dispatch.device_put_p, "impl", orig_put)
         return list(_PATCHED)

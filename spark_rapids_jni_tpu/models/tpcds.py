@@ -44,7 +44,6 @@ def load_tables(files: dict[str, bytes]) -> dict[str, Table]:
 
 
 def _eq_scalar_mask(col: Column, value) -> "np.ndarray":
-    import jax.numpy as jnp
     if col.dtype.id == T.TypeId.STRING:
         b = S.equal_to_scalar(col, value)
         m = b.data.astype(bool)
@@ -53,8 +52,19 @@ def _eq_scalar_mask(col: Column, value) -> "np.ndarray":
     return m if col.validity is None else (m & col.validity)
 
 
-def _col(table: Table, cols: list[str], name: str) -> int:
+def _col(cols: list[str], name: str) -> int:
     return cols.index(name)
+
+
+def _group_sum(joined: Table, cols: list[str], key_names: list[str],
+               value_name: str) -> Table:
+    """Shared tail of the reporting queries: GROUP BY keys, SUM(value),
+    deterministic key order.  ``cols`` is the joined column-name list
+    (inner_join's left ++ right contract)."""
+    out = groupby_aggregate(
+        joined, [cols.index(k) for k in key_names],
+        [(cols.index(value_name), "sum")])
+    return sort_table(out, list(range(len(key_names))))
 
 
 def q3(tables: dict[str, Table], manufact_id: int = 436,
@@ -65,22 +75,18 @@ def q3(tables: dict[str, Table], manufact_id: int = 436,
     GROUP BY d_year, i_brand_id, i_brand ORDER BY keys."""
     ss, item, dd = tables["store_sales"], tables["item"], tables["date_dim"]
     item_f = apply_boolean_mask(
-        item, _eq_scalar_mask(item[_col(item, ITEM_COLS, "i_manufact_id")],
+        item, _eq_scalar_mask(item[_col(ITEM_COLS, "i_manufact_id")],
                               manufact_id))
     dd_f = apply_boolean_mask(
-        dd, _eq_scalar_mask(dd[_col(dd, DATE_COLS, "d_moy")], moy))
-    j1 = inner_join(ss, item_f, _col(ss, SS_COLS, "ss_item_sk"),
-                    _col(item, ITEM_COLS, "i_item_sk"))
+        dd, _eq_scalar_mask(dd[_col(DATE_COLS, "d_moy")], moy))
+    j1 = inner_join(ss, item_f, _col(SS_COLS, "ss_item_sk"),
+                    _col(ITEM_COLS, "i_item_sk"))
     # j1 columns: SS_COLS ++ ITEM_COLS
-    j2 = inner_join(j1, dd_f, _col(ss, SS_COLS, "ss_sold_date_sk"),
-                    _col(dd, DATE_COLS, "d_date_sk"))
-    cols = SS_COLS + ITEM_COLS + DATE_COLS
-    out = groupby_aggregate(
-        j2,
-        [cols.index("d_year"), cols.index("i_brand_id"),
-         cols.index("i_brand")],
-        [(cols.index("ss_ext_sales_price"), "sum")])
-    return sort_table(out, [0, 1, 2])
+    j2 = inner_join(j1, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
+                    _col(DATE_COLS, "d_date_sk"))
+    return _group_sum(j2, SS_COLS + ITEM_COLS + DATE_COLS,
+                      ["d_year", "i_brand_id", "i_brand"],
+                      "ss_ext_sales_price")
 
 
 def q42(tables: dict[str, Table], manager_id: int = 1, year: int = 2000,
@@ -89,57 +95,46 @@ def q42(tables: dict[str, Table], manager_id: int = 1, year: int = 2000,
     predicates (Q42 shape)."""
     ss, item, dd = tables["store_sales"], tables["item"], tables["date_dim"]
     item_f = apply_boolean_mask(
-        item, _eq_scalar_mask(item[_col(item, ITEM_COLS, "i_manager_id")],
+        item, _eq_scalar_mask(item[_col(ITEM_COLS, "i_manager_id")],
                               manager_id))
-    dd_mask = (_eq_scalar_mask(dd[_col(dd, DATE_COLS, "d_moy")], moy)
-               & _eq_scalar_mask(dd[_col(dd, DATE_COLS, "d_year")], year))
+    dd_mask = (_eq_scalar_mask(dd[_col(DATE_COLS, "d_moy")], moy)
+               & _eq_scalar_mask(dd[_col(DATE_COLS, "d_year")], year))
     dd_f = apply_boolean_mask(dd, dd_mask)
-    j1 = inner_join(ss, item_f, _col(ss, SS_COLS, "ss_item_sk"),
-                    _col(item, ITEM_COLS, "i_item_sk"))
-    j2 = inner_join(j1, dd_f, _col(ss, SS_COLS, "ss_sold_date_sk"),
-                    _col(dd, DATE_COLS, "d_date_sk"))
-    cols = SS_COLS + ITEM_COLS + DATE_COLS
-    out = groupby_aggregate(
-        j2,
-        [cols.index("d_year"), cols.index("i_category_id"),
-         cols.index("i_category")],
-        [(cols.index("ss_ext_sales_price"), "sum")])
-    return sort_table(out, [0, 1, 2])
+    j1 = inner_join(ss, item_f, _col(SS_COLS, "ss_item_sk"),
+                    _col(ITEM_COLS, "i_item_sk"))
+    j2 = inner_join(j1, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
+                    _col(DATE_COLS, "d_date_sk"))
+    return _group_sum(j2, SS_COLS + ITEM_COLS + DATE_COLS,
+                      ["d_year", "i_category_id", "i_category"],
+                      "ss_ext_sales_price")
 
 
 def q52(tables: dict[str, Table], moy: int = 12, year: int = 2001) -> Table:
     """GROUP BY d_year, i_brand_id, i_brand for one month (Q52 shape)."""
     ss, item, dd = tables["store_sales"], tables["item"], tables["date_dim"]
-    dd_mask = (_eq_scalar_mask(dd[_col(dd, DATE_COLS, "d_moy")], moy)
-               & _eq_scalar_mask(dd[_col(dd, DATE_COLS, "d_year")], year))
+    dd_mask = (_eq_scalar_mask(dd[_col(DATE_COLS, "d_moy")], moy)
+               & _eq_scalar_mask(dd[_col(DATE_COLS, "d_year")], year))
     dd_f = apply_boolean_mask(dd, dd_mask)
-    j1 = inner_join(ss, dd_f, _col(ss, SS_COLS, "ss_sold_date_sk"),
-                    _col(dd, DATE_COLS, "d_date_sk"))
+    j1 = inner_join(ss, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
+                    _col(DATE_COLS, "d_date_sk"))
     cols1 = SS_COLS + DATE_COLS
     j2 = inner_join(j1, tables["item"], cols1.index("ss_item_sk"),
-                    _col(item, ITEM_COLS, "i_item_sk"))
-    cols = cols1 + ITEM_COLS
-    out = groupby_aggregate(
-        j2,
-        [cols.index("d_year"), cols.index("i_brand_id"),
-         cols.index("i_brand")],
-        [(cols.index("ss_ext_sales_price"), "sum")])
-    return sort_table(out, [0, 1, 2])
+                    _col(ITEM_COLS, "i_item_sk"))
+    return _group_sum(j2, cols1 + ITEM_COLS,
+                      ["d_year", "i_brand_id", "i_brand"],
+                      "ss_ext_sales_price")
 
 
 def q55(tables: dict[str, Table], manager_id: int = 28) -> Table:
     """GROUP BY i_brand_id, i_brand for one manager (Q55 shape)."""
     ss, item = tables["store_sales"], tables["item"]
     item_f = apply_boolean_mask(
-        item, _eq_scalar_mask(item[_col(item, ITEM_COLS, "i_manager_id")],
+        item, _eq_scalar_mask(item[_col(ITEM_COLS, "i_manager_id")],
                               manager_id))
-    j1 = inner_join(ss, item_f, _col(ss, SS_COLS, "ss_item_sk"),
-                    _col(item, ITEM_COLS, "i_item_sk"))
-    cols = SS_COLS + ITEM_COLS
-    out = groupby_aggregate(
-        j1, [cols.index("i_brand_id"), cols.index("i_brand")],
-        [(cols.index("ss_ext_sales_price"), "sum")])
-    return sort_table(out, [0, 1])
+    j1 = inner_join(ss, item_f, _col(SS_COLS, "ss_item_sk"),
+                    _col(ITEM_COLS, "i_item_sk"))
+    return _group_sum(j1, SS_COLS + ITEM_COLS,
+                      ["i_brand_id", "i_brand"], "ss_ext_sales_price")
 
 
 def q_state_rollup(tables: dict[str, Table], state: str = "TN") -> Table:
@@ -147,10 +142,10 @@ def q_state_rollup(tables: dict[str, Table], state: str = "TN") -> Table:
     predicate + decimal64(-2) sales-price sum and quantity mean."""
     ss, store = tables["store_sales"], tables["store"]
     store_f = apply_boolean_mask(
-        store, _eq_scalar_mask(store[_col(store, STORE_COLS, "s_state")],
+        store, _eq_scalar_mask(store[_col(STORE_COLS, "s_state")],
                                state))
-    j1 = inner_join(ss, store_f, _col(ss, SS_COLS, "ss_store_sk"),
-                    _col(store, STORE_COLS, "s_store_sk"))
+    j1 = inner_join(ss, store_f, _col(SS_COLS, "ss_store_sk"),
+                    _col(STORE_COLS, "s_store_sk"))
     cols = SS_COLS + STORE_COLS
     # the cents column IS the unscaled decimal payload — reinterpret as
     # decimal64(scale -2) (RowConversion.java:114-118 representation);

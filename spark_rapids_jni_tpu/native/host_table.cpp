@@ -341,6 +341,27 @@ void* srjt_rows_import(const uint8_t* data, int64_t data_size,
   return rb;
 }
 
+// Append one more ≤2GB batch to an imported RowBatches handle (the device
+// bridge marshals multi-batch conversions back one batch at a time).
+// Same untrusted-offset validation as srjt_rows_import; returns 0 on
+// rejection.
+int32_t srjt_rows_import_append(void* rows_handle, const uint8_t* data,
+                                int64_t data_size, const int32_t* offsets,
+                                int64_t n_rows) {
+  if (!rows_handle || !data || !offsets || n_rows < 0 || data_size < 0)
+    return 0;
+  if (offsets[0] != 0) return 0;
+  for (int64_t r = 0; r < n_rows; ++r) {
+    if (offsets[r + 1] < offsets[r]) return 0;
+  }
+  if (offsets[n_rows] != data_size) return 0;
+  RowBatches& rb = *static_cast<RowBatches*>(rows_handle);
+  rb.batches.emplace_back();
+  rb.batches.back().data.assign(data, data + data_size);
+  rb.batches.back().offsets.assign(offsets, offsets + n_rows + 1);
+  return 1;
+}
+
 // One batch of JCUDF rows → table (exactly one input batch, matching
 // convert_from_rows' contract, row_conversion.cu:2124-2139).
 void* srjt_from_rows(void* rows_handle, int32_t batch,

@@ -43,6 +43,12 @@ void* srjt_from_rows(void* rows, int32_t batch, const int32_t* type_ids,
                      const int32_t* scales, int32_t ncols);
 void srjt_rows_free(void* h);
 
+// device_bridge.cpp
+int32_t srjt_device_available();
+void* srjt_to_rows_device(void* table);
+void* srjt_from_rows_device(void* rows, const int32_t* type_ids,
+                            const int32_t* scales, int32_t ncols);
+
 // footer_engine.cpp
 void* srjt_footer_read_and_filter(const uint8_t* buf, uint64_t len,
                                   int64_t part_offset, int64_t part_length,
@@ -145,7 +151,11 @@ JNIEXPORT void JNICALL Java_com_tpu_rapids_jni_HostTable_close(
 
 JNIEXPORT jlong JNICALL Java_com_tpu_rapids_jni_RowConversion_convertToRows(
     JNIEnv* env, jclass, jlong table_handle) {
-  void* rows = srjt_to_rows(reinterpret_cast<void*>(table_handle));
+  // device engine first (the reference's JNI drives its device engine
+  // directly, RowConversionJni.cpp:24-45); host C++ engine is the
+  // staging/fallback tier when no runtime (or the device path fails)
+  void* rows = srjt_to_rows_device(reinterpret_cast<void*>(table_handle));
+  if (!rows) rows = srjt_to_rows(reinterpret_cast<void*>(table_handle));
   if (!rows)
     THROW_ILLEGAL(env,
                   "Row size exceeds JCUDF 1KB limit or unsupported schema "
@@ -169,8 +179,14 @@ JNIEXPORT jlong JNICALL Java_com_tpu_rapids_jni_RowConversion_convertFromRows(
   std::vector<jint> types(n), scl(n);
   ENV(GetIntArrayRegion, type_ids, 0, n, types.data());
   if (scales) ENV(GetIntArrayRegion, scales, 0, n, scl.data());
-  void* t = srjt_from_rows(reinterpret_cast<void*>(rows_handle), batch,
-                           types.data(), scales ? scl.data() : nullptr, n);
+  void* t = nullptr;
+  if (batch == 0) {   // device engine decodes batch 0 (one-batch contract)
+    t = srjt_from_rows_device(reinterpret_cast<void*>(rows_handle),
+                              types.data(), scales ? scl.data() : nullptr, n);
+  }
+  if (!t)
+    t = srjt_from_rows(reinterpret_cast<void*>(rows_handle), batch,
+                       types.data(), scales ? scl.data() : nullptr, n);
   if (!t) THROW_ILLEGAL(env, "bad batch index or unsupported schema");
   return reinterpret_cast<jlong>(t);
 }

@@ -36,6 +36,11 @@ def order_by(table: Table, keys: Sequence[int],
             if not asc:
                 data = -data if data.dtype.kind == "f" else ~data  # order-reversing
             key_lanes = [data]
+            if data.dtype.kind == "f" and not asc:
+                # Spark orders NaN as the LARGEST value: ascending sorts
+                # place it last natively, but negation keeps NaN last, so
+                # descending needs an explicit NaN-first rank lane
+                key_lanes.append(jnp.where(jnp.isnan(data), 0, 1))
         lanes.extend(key_lanes)
         if col.validity is not None:
             # the rank lane always sorts ascending, independent of the data

@@ -73,6 +73,13 @@ def _is_f64(storage: np.dtype) -> bool:
     return storage.kind == "f" and storage.itemsize == 8
 
 
+# Row-word count above which the fixed transcode interleaves via one
+# [W, n] transpose instead of W strided lane writes/reads: strided ops
+# don't fuse, costing W full passes (O(W²) at the reference's 212-column
+# bench schema), while [n, W]'s lane padding is ≤ ~2× once W > 48.
+_W_STRIDED_MAX = 48
+
+
 def _byte_view(data: jnp.ndarray, storage: np.dtype) -> jnp.ndarray:
     """[n] fixed-width values → uint8 [n, itemsize] (little-endian).
 
@@ -139,16 +146,62 @@ def _to_rows_fixed_impl(layout: RowLayout, use_pallas: bool,
     if use_pallas:
         from . import pallas_kernels
         return pallas_kernels.to_rows_fixed(layout, tuple(datas), valid)
+    # Wide formulation (mirror of _from_rows_fixed_impl): compose each row
+    # word as a [n]-long u32 vector from statically-planned column
+    # fragments, then interleave with wide-minor strided lane writes —
+    # per-column u8 slice writes into [n, row_size] force padded small-
+    # minor layouts on TPU.
+    from . import pallas_kernels as pk
+    from . import ragged
     n = valid.shape[0]
-    out = jnp.zeros((n, layout.fixed_row_size), dtype=jnp.uint8)
-    for ci, dt in enumerate(layout.schema):
-        start = layout.column_starts[ci]
-        b = _byte_view(datas[ci], dt.storage)
-        out = out.at[:, start:start + layout.column_sizes[ci]].set(b)
-    vbytes = bitmask.pack_bool_matrix(valid)
-    out = out.at[:, layout.validity_offset:
-                 layout.validity_offset + layout.validity_bytes].set(vbytes)
-    return out
+    W = layout.fixed_row_size // 4
+    n_pad = -(-n // 128) * 128
+
+    def padrows(x):
+        return jnp.pad(x, [(0, n_pad - n)] + [(0, 0)] * (x.ndim - 1))
+
+    staged = [padrows(pk._stage_column(d, dt.storage))
+              for d, dt in zip(datas, layout.schema)]
+    vbytes_w = []
+    for k in range(layout.validity_bytes):
+        acc = jnp.zeros((n,), jnp.uint32)
+        for i in range(min(8, layout.num_columns - k * 8)):
+            acc = acc | (valid[:, k * 8 + i].astype(jnp.uint32)
+                         << jnp.uint32(i))
+        vbytes_w.append(padrows(acc))
+
+    plan = pk._word_plan(layout)
+    words = []
+    for w in range(W):
+        acc = None
+        for ii, kind, arg in plan[w]:
+            if kind == "vbyte":
+                k, shift = arg
+                v = vbytes_w[k] << jnp.uint32(shift * 8)
+            else:
+                x = staged[ii]
+                if kind == "full":
+                    v = x
+                elif kind == "pair":
+                    v = x[:, arg]
+                else:
+                    v = x << jnp.uint32(arg * 8)
+            acc = v if acc is None else acc | v
+        words.append(acc if acc is not None
+                     else jnp.zeros((n_pad,), jnp.uint32))
+    if W <= _W_STRIDED_MAX:
+        # narrow: W strided lane writes into a wide-minor buffer
+        out2 = jnp.zeros((n_pad // 128, 128 * W), dtype=jnp.uint32)
+        for w in range(W):
+            out2 = out2.at[:, w::W].set(words[w].reshape(n_pad // 128, 128))
+        flat_w = out2.reshape(-1)
+    else:
+        # wide: strided writes cost W passes (O(W²) traffic at 212 cols);
+        # one [W, n]→[n, W] transpose is a single pass and [n, W]'s minor
+        # padding to the 128-lane tile is ≤ ~2× for W > 48
+        flat_w = jnp.stack(words, axis=0).T.reshape(-1)
+    return ragged.u32_to_u8(flat_w).reshape(
+        n_pad, layout.fixed_row_size)[:n]
 
 
 def _from_rows_fixed(layout: RowLayout, rows: jnp.ndarray,
@@ -169,14 +222,60 @@ def _from_rows_fixed_impl(layout: RowLayout, use_pallas: bool,
     if use_pallas:
         from . import pallas_kernels
         return pallas_kernels.from_rows_fixed(layout, rows)
+    # Wide formulation: deinterleave the row words into [n]-long vectors
+    # with wide-minor strided slices, then extract columns with shifts —
+    # per-column narrow u8 slices of the [n, row_size] matrix force padded
+    # (…,small)-minor layouts on TPU and ran ~50× slower at 212 columns.
+    from . import ragged
+    n = rows.shape[0]
+    R = layout.fixed_row_size
+    W = R // 4
+    n_pad = -(-n // 128) * 128
+    rows_p = jnp.pad(rows, ((0, n_pad - n), (0, 0)))
+    w32 = ragged.u8_to_u32(rows_p.reshape(-1))           # [n_pad*W]
+    if W <= _W_STRIDED_MAX:
+        x2 = w32.reshape(n_pad // 128, 128 * W)
+
+        def word(w):
+            return x2[:, w::W].reshape(-1)               # [n_pad]
+    else:
+        # wide: one transpose instead of W strided slices (see
+        # _to_rows_fixed_impl); sublane rows of the transposed matrix are
+        # cheap to read
+        t2 = w32.reshape(n_pad, W).T                     # [W, n_pad]
+
+        def word(w):
+            return t2[w]
+
     datas = []
     for ci, dt in enumerate(layout.schema):
         start = layout.column_starts[ci]
-        b = rows[:, start:start + layout.column_sizes[ci]]
-        datas.append(_from_bytes(b, dt.storage))
-    vbytes = rows[:, layout.validity_offset:
-                  layout.validity_offset + layout.validity_bytes]
-    valid = bitmask.unpack_bool_matrix(vbytes, layout.num_columns)
+        size = layout.column_sizes[ci]
+        st = dt.storage
+        if size == 8:
+            pair = jnp.stack([word(start // 4), word(start // 4 + 1)],
+                             axis=1)[:n]
+            if _is_f64(st):
+                datas.append(pair)                       # staged convention
+            else:
+                datas.append(jax.lax.bitcast_convert_type(pair,
+                                                          jnp.dtype(st)))
+        elif size == 4:
+            datas.append(jax.lax.bitcast_convert_type(word(start // 4),
+                                                      jnp.dtype(st))[:n])
+        else:
+            v = ((word(start // 4) >> jnp.uint32(8 * (start % 4)))
+                 & jnp.uint32((1 << (8 * size)) - 1))
+            unsigned = np.dtype(f"u{size}")
+            datas.append(jax.lax.bitcast_convert_type(
+                v.astype(jnp.dtype(unsigned)), jnp.dtype(st))[:n])
+    vcols = []
+    for c in range(layout.num_columns):
+        byte = layout.validity_offset + c // 8
+        bit = ((word(byte // 4) >> jnp.uint32(8 * (byte % 4) + c % 8))
+               & jnp.uint32(1))
+        vcols.append(bit.astype(jnp.bool_)[:n])
+    valid = jnp.stack(vcols, axis=1)
     return tuple(datas), valid
 
 
@@ -308,8 +407,13 @@ def _to_rows_var_dma(layout: RowLayout, sub: "Table", valid: jnp.ndarray,
     lens_np = np.stack([o[1:] - o[:-1] for o in col_offs_np], axis=1)
     prefix_np = np.cumsum(lens_np, axis=1) - lens_np
 
+    # var columns' char payloads are unread by _var_fixed_region (slots come
+    # from the offsets); zero-size placeholders keep its jit cache keyed on
+    # (layout, n) only instead of every distinct char-buffer length
     fixed2d = _var_fixed_region(
-        layout, tuple(_stage(c) for c in sub.columns),
+        layout,
+        tuple(jnp.zeros(0, jnp.uint8) if c.dtype.is_variable_width
+              else _stage(c) for c in sub.columns),
         tuple(sub[ci].offsets for ci in var_idx), valid)
 
     total_chars = int(lens_np.sum())
@@ -676,10 +780,15 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
                                     layout.fixed_plus_validity)
         datas, valid, slots = _var_fixed_extract(layout, fixed_dense)
         row_sizes_np = offs_np[1:] - offs_np[:-1]
+        # ONE host sync for all columns' slots (each eager transfer costs a
+        # full round-trip on remote backends); mirrors the reference's
+        # single sync on the scanned totals (row_conversion.cu:2215)
+        slots_np = (np.asarray(jnp.stack(slots), dtype=np.int64)
+                    if slots else np.zeros((0, n, 2), np.int64))
         out_offsets = []
         chars = []
         for vi in range(len(layout.variable_column_indices)):
-            s = np.asarray(slots[vi], dtype=np.int64)       # host sync
+            s = slots_np[vi]
             lens = s[:, 1]
             # rows may be shuffle-received: validate the embedded slots
             # before sizing any allocation (same hardening as the C++ host

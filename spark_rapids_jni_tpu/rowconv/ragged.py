@@ -96,16 +96,23 @@ def _soft_bucket(x: int, lo: int = 8) -> int:
 # at GB scale.  These helpers keep every intermediate ≥ 512B-minor.
 # ---------------------------------------------------------------------------
 
+@jax.jit
 def u8_to_u32(x: jnp.ndarray) -> jnp.ndarray:
-    """u8 [4N] → u32 [N] (little-endian), N multiple of 128."""
+    """u8 [4N] → u32 [N] (little-endian), N multiple of 128.
+
+    Jitted: these helpers run between pallas_call invocations in otherwise
+    eager host orchestration, and each eager jnp op costs a full dispatch
+    round-trip on remote backends.
+    """
     x2 = x.reshape(-1, 4 * LANE)
     parts = [x2[:, k::4].astype(jnp.uint32) for k in range(4)]
     w = parts[0] | (parts[1] << 8) | (parts[2] << 16) | (parts[3] << 24)
     return w.reshape(-1)
 
 
+@jax.jit
 def u32_to_u8(w: jnp.ndarray) -> jnp.ndarray:
-    """u32 [N] → u8 [4N], N multiple of 128."""
+    """u32 [N] → u8 [4N], N multiple of 128 (jitted, see u8_to_u32)."""
     w2 = w.reshape(-1, LANE)
     out = jnp.zeros((w2.shape[0], 4 * LANE), jnp.uint8)
     for k in range(4):
@@ -242,6 +249,10 @@ def _pack_rows_impl(dense, row_offsets, block_bytes):
     # bucket every data-dependent static so nearby geometries share one
     # compiled kernel (each unique static tuple costs a full Mosaic compile)
     NR = _pow2_bucket(NR, 8)
+    if NR * Mw * 4 > (1 << 21):
+        # many tiny rows against a large M: the staged row window would
+        # exceed VMEM — ValueError so pack() degrades to the XLA fallback
+        raise ValueError("pack_rows: row window exceeds VMEM budget")
     KOFF = _pow2_bucket(NR // LANE + 2, 2)
     nblocks_q = _soft_bucket(nblocks, 1)
     pad_blk = nblocks_q - nblocks
@@ -466,8 +477,11 @@ def _segmented_copy_impl(src, src_offs, dst_offs, sizes, dst_size, B):
                             np.minimum(np.arange(1, nblocks + 1,
                                                  dtype=np.int64) * B,
                                        dst_size), side="left")
-    s_begin = np.minimum(s_begin, np.maximum(s_end - 1, 0))
+    # segment count BEFORE the index clamp: blocks past dst_size (from the
+    # nblocks bucketing) have s_begin == s_end == n and must get ns=0, or
+    # each would pay a window DMA + roll for a fully-masked stale segment
     ns = np.maximum(s_end - s_begin, 0).astype(np.int32)
+    s_begin = np.minimum(s_begin, np.maximum(s_end - 1, 0))
 
     # staged source window per block (512B-aligned)
     w_begin = src_offs[np.minimum(s_begin, n - 1)]
@@ -503,25 +517,28 @@ def _segmented_copy_impl(src, src_offs, dst_offs, sizes, dst_size, B):
     def kernel(sw_ref, sb_ref, ns_ref, srcm_hbm, dstm_hbm, szm_hbm, src_hbm,
                out_ref, win, ssrc, sdst, ssz, sems):
         b = pl.program_id(0)
-        dma = pltpu.make_async_copy(src_hbm.at[pl.ds(sw_ref[b], KSw)], win,
-                                    sems.at[0])
-        dma.start()
         m0 = sb_ref[b] // LANE
-        for k in range(KMETA):
-            pltpu.make_async_copy(srcm_hbm.at[m0 + k], ssrc.at[k],
-                                  sems.at[1 + 3 * k]).start()
-            pltpu.make_async_copy(dstm_hbm.at[m0 + k], sdst.at[k],
-                                  sems.at[2 + 3 * k]).start()
-            pltpu.make_async_copy(szm_hbm.at[m0 + k], ssz.at[k],
-                                  sems.at[3 + 3 * k]).start()
-        dma.wait()
-        for k in range(KMETA):
-            pltpu.make_async_copy(srcm_hbm.at[m0 + k], ssrc.at[k],
-                                  sems.at[1 + 3 * k]).wait()
-            pltpu.make_async_copy(dstm_hbm.at[m0 + k], sdst.at[k],
-                                  sems.at[2 + 3 * k]).wait()
-            pltpu.make_async_copy(szm_hbm.at[m0 + k], ssz.at[k],
-                                  sems.at[3 + 3 * k]).wait()
+
+        @pl.when(ns_ref[b] > 0)
+        def _stage():
+            dma = pltpu.make_async_copy(src_hbm.at[pl.ds(sw_ref[b], KSw)],
+                                        win, sems.at[0])
+            dma.start()
+            for k in range(KMETA):
+                pltpu.make_async_copy(srcm_hbm.at[m0 + k], ssrc.at[k],
+                                      sems.at[1 + 3 * k]).start()
+                pltpu.make_async_copy(dstm_hbm.at[m0 + k], sdst.at[k],
+                                      sems.at[2 + 3 * k]).start()
+                pltpu.make_async_copy(szm_hbm.at[m0 + k], ssz.at[k],
+                                      sems.at[3 + 3 * k]).start()
+            dma.wait()
+            for k in range(KMETA):
+                pltpu.make_async_copy(srcm_hbm.at[m0 + k], ssrc.at[k],
+                                      sems.at[1 + 3 * k]).wait()
+                pltpu.make_async_copy(dstm_hbm.at[m0 + k], sdst.at[k],
+                                      sems.at[2 + 3 * k]).wait()
+                pltpu.make_async_copy(szm_hbm.at[m0 + k], ssz.at[k],
+                                      sems.at[3 + 3 * k]).wait()
 
         w = win[...]
         blk_start = b * B
@@ -645,7 +662,10 @@ def unpack_rows_xla(flat: jnp.ndarray, row_offsets: np.ndarray,
 def pack(dense: jnp.ndarray, row_offsets: np.ndarray) -> jnp.ndarray:
     """Dispatching pack: DMA kernels on TPU, XLA gather elsewhere."""
     if dma_supported():
-        return pack_rows(dense, row_offsets)
+        try:
+            return pack_rows(dense, row_offsets)
+        except ValueError:   # row window exceeds VMEM budget — degrade
+            pass
     return pack_rows_xla(dense, row_offsets)
 
 

@@ -1,0 +1,132 @@
+"""Device-bridge tests: bytes entering through the C/JNI surface are
+transcoded by the DEVICE engine (VERDICT round-1 item 6; the reference's
+JNI drives its device engine directly, RowConversionJni.cpp:24-45).
+
+The pytest process hosts CPython, so ``srjt_device_available()`` is true
+and ``srjt_to_rows_device`` round-trips through
+``spark_rapids_jni_tpu.bridge`` → JAX engine → ``srjt_rows_import``.  The
+host C++ engine output is the byte-exact oracle.
+"""
+
+import ctypes as C
+import os
+
+import numpy as np
+import pytest
+
+_LIB = os.path.join(os.path.dirname(__file__), "..",
+                    "spark_rapids_jni_tpu", "native", "libsrjt.so")
+if not os.path.exists(_LIB):
+    pytest.skip("libsrjt.so not built", allow_module_level=True)
+
+# the bridge module must resolve the SAME library instance
+import spark_rapids_jni_tpu  # noqa: F401  (initializes jax/x64)
+
+lib = C.CDLL(_LIB)
+lib.srjt_column_fixed.restype = C.c_void_p
+lib.srjt_column_fixed.argtypes = [C.c_int32, C.c_int32, C.c_int64,
+                                  C.c_void_p, C.c_void_p]
+lib.srjt_column_string.restype = C.c_void_p
+lib.srjt_column_string.argtypes = [C.c_int64, C.c_void_p, C.c_void_p,
+                                   C.c_void_p]
+lib.srjt_column_free.argtypes = [C.c_void_p]
+lib.srjt_table.restype = C.c_void_p
+lib.srjt_table.argtypes = [C.POINTER(C.c_void_p), C.c_int32]
+lib.srjt_table_free.argtypes = [C.c_void_p]
+lib.srjt_to_rows.restype = C.c_void_p
+lib.srjt_to_rows.argtypes = [C.c_void_p]
+lib.srjt_to_rows_device.restype = C.c_void_p
+lib.srjt_to_rows_device.argtypes = [C.c_void_p]
+lib.srjt_from_rows_device.restype = C.c_void_p
+lib.srjt_from_rows_device.argtypes = [C.c_void_p, C.c_void_p, C.c_void_p,
+                                      C.c_int32]
+lib.srjt_device_available.restype = C.c_int32
+lib.srjt_rows_free.argtypes = [C.c_void_p]
+lib.srjt_rows_num_batches.restype = C.c_int32
+lib.srjt_rows_num_batches.argtypes = [C.c_void_p]
+lib.srjt_rows_batch_data.restype = C.POINTER(C.c_uint8)
+lib.srjt_rows_batch_data.argtypes = [C.c_void_p, C.c_int32]
+lib.srjt_rows_batch_size.restype = C.c_int64
+lib.srjt_rows_batch_size.argtypes = [C.c_void_p, C.c_int32]
+lib.srjt_table_cols.restype = C.c_int32
+lib.srjt_table_cols.argtypes = [C.c_void_p]
+lib.srjt_table_rows.restype = C.c_int64
+lib.srjt_table_rows.argtypes = [C.c_void_p]
+lib.srjt_table_column.restype = C.c_void_p
+lib.srjt_table_column.argtypes = [C.c_void_p, C.c_int32]
+lib.srjt_column_data.restype = C.POINTER(C.c_uint8)
+lib.srjt_column_data.argtypes = [C.c_void_p]
+lib.srjt_column_data_size.restype = C.c_int64
+lib.srjt_column_data_size.argtypes = [C.c_void_p]
+
+INT32, INT64, STRING = 3, 4, 24
+
+
+def _np_ptr(a):
+    return a.ctypes.data_as(C.c_void_p)
+
+
+def _mixed_table(n=257):
+    rng = np.random.default_rng(5)
+    ints = rng.integers(-1000, 1000, n).astype(np.int32)
+    longs = rng.integers(-10**12, 10**12, n).astype(np.int64)
+    lens = rng.integers(0, 9, n).astype(np.int64)
+    offs = np.zeros(n + 1, dtype=np.int32)
+    np.cumsum(lens, out=offs[1:])
+    chars = rng.integers(97, 123, int(offs[-1])).astype(np.uint8)
+    valid = (rng.random(n) < 0.9).astype(np.uint8)
+    h1 = lib.srjt_column_fixed(INT32, 0, n, _np_ptr(ints), _np_ptr(valid))
+    h2 = lib.srjt_column_string(n, _np_ptr(offs), _np_ptr(chars), None)
+    h3 = lib.srjt_column_fixed(INT64, 0, n, _np_ptr(longs), None)
+    arr = (C.c_void_p * 3)(h1, h2, h3)
+    t = lib.srjt_table(arr, 3)
+    for h in (h1, h2, h3):
+        lib.srjt_column_free(h)
+    return t, (ints, offs, chars, valid, longs)
+
+
+def _batch_bytes(rows):
+    size = lib.srjt_rows_batch_size(rows, 0)
+    return np.ctypeslib.as_array(lib.srjt_rows_batch_data(rows, 0),
+                                 shape=(size,)).copy()
+
+
+def test_device_available_in_python_process():
+    assert lib.srjt_device_available() == 1
+
+
+def test_to_rows_device_matches_host_engine():
+    t, _ = _mixed_table()
+    host = lib.srjt_to_rows(t)
+    dev = lib.srjt_to_rows_device(t)
+    assert host and dev, "both engines must produce rows"
+    assert lib.srjt_rows_num_batches(dev) == lib.srjt_rows_num_batches(host)
+    np.testing.assert_array_equal(_batch_bytes(dev), _batch_bytes(host))
+    lib.srjt_rows_free(host)
+    lib.srjt_rows_free(dev)
+    lib.srjt_table_free(t)
+
+
+def test_from_rows_device_roundtrip():
+    t, (ints, offs, chars, valid, longs) = _mixed_table()
+    rows = lib.srjt_to_rows_device(t)
+    assert rows
+    tids = np.asarray([INT32, STRING, INT64], dtype=np.int32)
+    scales = np.zeros(3, dtype=np.int32)
+    back = lib.srjt_from_rows_device(rows, _np_ptr(tids), _np_ptr(scales), 3)
+    assert back
+    assert lib.srjt_table_cols(back) == 3
+    assert lib.srjt_table_rows(back) == len(ints)
+    # int32 column payload must round-trip byte-exactly
+    c0 = C.c_void_p(lib.srjt_table_column(back, 0))
+    raw = np.ctypeslib.as_array(lib.srjt_column_data(c0),
+                                shape=(lib.srjt_column_data_size(c0),))
+    np.testing.assert_array_equal(raw.view(np.int32), ints)
+    # string chars round-trip
+    c1 = C.c_void_p(lib.srjt_table_column(back, 1))
+    raw1 = np.ctypeslib.as_array(lib.srjt_column_data(c1),
+                                 shape=(lib.srjt_column_data_size(c1),))
+    np.testing.assert_array_equal(raw1, chars)
+    lib.srjt_rows_free(rows)
+    lib.srjt_table_free(t)
+    lib.srjt_table_free(back)

@@ -206,3 +206,35 @@ def test_join_empty_right():
     out = ops.left_join(lt, rt, 0, 0)
     assert out[0].to_pylist() == [1, 2]
     assert out[1].to_pylist() == [None, None]
+
+
+# ---- Spark float ordering: NaN is the largest value -----------------------
+
+def test_sort_float_nan_ordering():
+    import numpy as np
+    from spark_rapids_jni_tpu import Column, Table
+    from spark_rapids_jni_tpu.ops import sort_table
+    vals = np.asarray([1.5, np.nan, -2.0, 0.0, np.nan, 7.25],
+                      dtype=np.float32)
+    t = Table([Column.from_numpy(vals)])
+    asc = sort_table(t, [0])[0].to_numpy()
+    # ascending: NaN last (Spark: NaN > everything)
+    assert np.isnan(asc[-2:]).all() and not np.isnan(asc[:-2]).any()
+    np.testing.assert_array_equal(asc[:-2], np.sort(vals[~np.isnan(vals)]))
+    desc = sort_table(t, [0], ascending=[False])[0].to_numpy()
+    # descending: NaN first
+    assert np.isnan(desc[:2]).all() and not np.isnan(desc[2:]).any()
+    np.testing.assert_array_equal(
+        desc[2:], np.sort(vals[~np.isnan(vals)])[::-1])
+
+
+def test_sort_negative_zero_equal():
+    import numpy as np
+    from spark_rapids_jni_tpu import Column, Table
+    from spark_rapids_jni_tpu.ops import sort_table
+    vals = np.asarray([-0.0, 1.0, 0.0, -1.0], dtype=np.float64)
+    for asc in (True, False):
+        got = sort_table(Table([Column.from_numpy(vals)]), [0],
+                         ascending=[asc])[0].to_numpy()
+        expect = np.sort(vals) if asc else np.sort(vals)[::-1]
+        np.testing.assert_array_equal(np.sign(got) + got, np.sign(expect) + expect)

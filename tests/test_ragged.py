@@ -14,19 +14,7 @@ import jax.numpy as jnp
 from spark_rapids_jni_tpu.rowconv import ragged
 
 
-def _random_ragged(rng, n, M, aligned=False):
-    if aligned:
-        sizes = rng.integers(1, M // 8 + 1, n) * 8
-    else:
-        sizes = rng.integers(0, M + 1, n)
-    offs = np.zeros(n + 1, dtype=np.int64)
-    np.cumsum(sizes, out=offs[1:])
-    dense = np.zeros((n, M), dtype=np.uint8)
-    for r in range(n):
-        dense[r, :sizes[r]] = rng.integers(1, 256, sizes[r])
-    flat = (np.concatenate([dense[r, :sizes[r]] for r in range(n)])
-            if offs[-1] else np.zeros(0, np.uint8))
-    return dense, offs, flat
+from benchmarks.ragged_data import random_ragged as _random_ragged  # noqa: E402
 
 
 @pytest.mark.parametrize("n,M,aligned", [(64, 48, True), (301, 64, False),

@@ -43,20 +43,11 @@ def record(name, ok, note=""):
 
 
 def check_ragged():
+    from benchmarks.ragged_data import random_ragged
     rng = np.random.default_rng(0)
     for n, M, aligned in [(301, 64, False), (1000, 256, False),
                           (777, 33, False), (4097, 300, True)]:
-        if aligned:
-            sizes = rng.integers(1, M // 8 + 1, n) * 8
-        else:
-            sizes = rng.integers(0, M + 1, n)
-        offs = np.zeros(n + 1, dtype=np.int64)
-        np.cumsum(sizes, out=offs[1:])
-        dense = np.zeros((n, M), dtype=np.uint8)
-        for r in range(n):
-            dense[r, :sizes[r]] = rng.integers(1, 256, sizes[r])
-        flat = (np.concatenate([dense[r, :sizes[r]] for r in range(n)])
-                if offs[-1] else np.zeros(0, np.uint8))
+        dense, offs, flat = random_ragged(rng, n, M, aligned)
         got = np.asarray(ragged.pack_rows(jnp.asarray(dense), offs))
         record(f"ragged.pack n={n} M={M}", np.array_equal(got, flat))
         got2 = np.asarray(ragged.unpack_rows(jnp.asarray(flat), offs, M))
