@@ -48,19 +48,24 @@ class Column:
     data: jnp.ndarray
     offsets: Optional[jnp.ndarray] = None
     validity: Optional[jnp.ndarray] = None
+    # Child columns for nested types (cudf column hierarchy analog):
+    # LIST → [element column]; STRUCT → one per field.  None for leaves.
+    children: Optional[list["Column"]] = None
 
     # -- pytree protocol ----------------------------------------------------
     def tree_flatten(self):
-        return (self.data, self.offsets, self.validity), self.dtype
+        return (self.data, self.offsets, self.validity, self.children), self.dtype
 
     @classmethod
-    def tree_unflatten(cls, dtype, children):
-        data, offsets, validity = children
-        return cls(dtype, data, offsets, validity)
+    def tree_unflatten(cls, dtype, leaves):
+        data, offsets, validity, children = leaves
+        return cls(dtype, data, offsets, validity, children)
 
     # -- basics -------------------------------------------------------------
     @property
     def num_rows(self) -> int:
+        if self.dtype.id == T.TypeId.STRUCT:
+            return self.children[0].num_rows
         if self.dtype.is_variable_width:
             return self.offsets.shape[0] - 1
         return self.data.shape[0]
@@ -106,6 +111,42 @@ class Column:
         v = None if valid.all() else jnp.asarray(valid)
         return Column(T.string, jnp.asarray(chars), jnp.asarray(offsets), v)
 
+    @staticmethod
+    def list_from_pylist(values, element_dtype: T.DType | None = None) -> "Column":
+        """Build a LIST column from nested host lists (None ⇒ null row).
+
+        Elements may themselves be lists/strings/scalars; the element column
+        is built recursively (cudf make_lists_column analog,
+        ``row_conversion.cu:1264``).
+        """
+        valid = np.asarray([v is not None for v in values], dtype=bool)
+        flat = []
+        lengths = np.zeros(len(values), dtype=np.int32)
+        for i, v in enumerate(values):
+            if v is not None:
+                flat.extend(v)
+                lengths[i] = len(v)
+        offsets = np.zeros(len(values) + 1, dtype=np.int32)
+        np.cumsum(lengths, out=offsets[1:])
+        child = _column_from_pylist(flat, element_dtype)
+        v = None if valid.all() else jnp.asarray(valid)
+        dtype = T.list_(child.dtype)
+        return Column(dtype, jnp.zeros((0,), jnp.uint8), jnp.asarray(offsets),
+                      v, [child])
+
+    @staticmethod
+    def struct_from_columns(fields: Sequence["Column"],
+                            validity: np.ndarray | None = None) -> "Column":
+        """Build a STRUCT column from equal-length field columns."""
+        fields = list(fields)
+        n = fields[0].num_rows
+        for f in fields:
+            if f.num_rows != n:
+                raise ValueError("struct fields must have equal length")
+        v = None if validity is None else jnp.asarray(np.asarray(validity, bool))
+        dtype = T.struct_(*[f.dtype for f in fields])
+        return Column(dtype, jnp.zeros((0,), jnp.uint8), None, v, fields)
+
     # -- host round-trip (tests / interchange) ------------------------------
     def to_numpy(self) -> np.ndarray:
         """Host copy of the payload (fixed-width columns only)."""
@@ -124,10 +165,47 @@ class Column:
                 else:
                     out.append(chars[offsets[i]:offsets[i + 1]].decode("utf-8"))
             return out
+        if self.dtype.id == T.TypeId.LIST:
+            offsets = np.asarray(self.offsets)
+            elems = self.children[0].to_pylist()
+            return [elems[offsets[i]:offsets[i + 1]] if valid[i] else None
+                    for i in range(self.num_rows)]
+        if self.dtype.id == T.TypeId.STRUCT:
+            field_vals = [f.to_pylist() for f in self.children]
+            return [tuple(fv[i] for fv in field_vals) if valid[i] else None
+                    for i in range(self.num_rows)]
+        if self.dtype.id == T.TypeId.DECIMAL128:
+            lanes = np.asarray(self.data)
+            lo = lanes[:, 0].astype(np.uint64)
+            hi = lanes[:, 1].astype(np.int64)
+            return [int(hi[i]) * (1 << 64) + int(lo[i]) if valid[i] else None
+                    for i in range(self.num_rows)]
         vals = np.asarray(self.data)
         if self.dtype.id == T.TypeId.BOOL8:
             vals = vals.astype(bool)
         return [vals[i].item() if valid[i] else None for i in range(self.num_rows)]
+
+
+def _column_from_pylist(values, dtype: T.DType | None = None) -> Column:
+    """Build a column from a flat host list, inferring the type if needed."""
+    if dtype is not None and dtype.id == T.TypeId.LIST:
+        return Column.list_from_pylist(values, dtype.children[0])
+    if dtype is not None and dtype.id == T.TypeId.STRING:
+        return Column.strings_from_list(values)
+    sample = next((v for v in values if v is not None), None)
+    if dtype is None:
+        if isinstance(sample, str):
+            return Column.strings_from_list(values)
+        if isinstance(sample, (list, tuple)):
+            return Column.list_from_pylist(values)
+    arr = np.asarray([0 if v is None else v for v in values])
+    validity = (np.asarray([v is not None for v in values])
+                if any(v is None for v in values) else None)
+    if dtype is not None:
+        arr = arr.astype(dtype.storage)
+    elif not values:
+        arr = arr.astype(np.int32)
+    return Column.from_numpy(arr, dtype, validity)
 
 
 @jax.tree_util.register_pytree_node_class

@@ -27,6 +27,9 @@ def cast(col: Column, to: T.DType) -> Column:
     if src.id == T.TypeId.STRING or to.id == T.TypeId.STRING:
         raise NotImplementedError("string casts live in ops.strings")
 
+    if src.id == T.TypeId.DECIMAL128 or to.id == T.TypeId.DECIMAL128:
+        return _cast_decimal128(col, to)
+
     data = col.data
     if src.is_decimal and to.is_decimal:
         data = _rescale(data, src.scale, to.scale).astype(to.storage)
@@ -49,6 +52,47 @@ def cast(col: Column, to: T.DType) -> Column:
     else:
         data = data.astype(to.storage)
     return Column(to, data, validity=col.validity)
+
+
+def _cast_decimal128(col: Column, to: T.DType) -> Column:
+    """Casts in/out of the [n,2]-lane DECIMAL128 representation."""
+    from . import decimal128 as d128
+    src = col.dtype
+    if src.id == T.TypeId.DECIMAL128:
+        if to.id == T.TypeId.DECIMAL128:
+            return d128.rescale(col, to.scale)
+        if to.id == T.TypeId.FLOAT64:
+            return d128.to_float64(col)
+        if to.is_decimal or to.is_numeric:
+            mid = col if to.scale == src.scale else d128.rescale(col, to.scale)
+            return d128.narrow(mid, to)
+        raise NotImplementedError(f"decimal128 → {to.id.name}")
+    # widening into decimal128
+    if src.is_decimal or src.storage.kind in "iu" or src.id == T.TypeId.BOOL8:
+        wide = d128.widen(col)
+        if wide.dtype.scale != to.scale:
+            wide = d128.rescale(wide, to.scale)
+        return wide
+    if src.storage.kind == "f":
+        # float → decimal128 by two-limb split: a float64 mantissa is 53
+        # bits, so hi = ⌊x/2^64⌋ and lo = x - hi·2^64 are each exact in f64
+        # and together reach the full 128-bit range (an int64 intermediate
+        # would silently wrap above 2^63).  Exact on CPU; on TPU, f64
+        # div/floor are emulated and may be a few ulp off above 2^64.
+        scaled = jnp.round(
+            col.data.astype(jnp.float64) * np.float64(10.0) ** (-to.scale))
+        neg = scaled < 0
+        mag = jnp.abs(scaled)
+        hi_f = jnp.floor(mag / (2.0 ** 64))
+        lo_f = mag - hi_f * (2.0 ** 64)            # in [0, 2^64)
+        lo = jnp.where(lo_f >= 2.0 ** 63,
+                       (lo_f - 2.0 ** 64).astype(jnp.int64),
+                       lo_f.astype(jnp.int64))
+        hi = hi_f.astype(jnp.int64)
+        lanes = jnp.stack([lo, hi], axis=1)
+        lanes = jnp.where(neg[:, None], d128._negate_lanes(lanes), lanes)
+        return Column(T.decimal128(to.scale), lanes, validity=col.validity)
+    raise NotImplementedError(f"{src.id.name} → decimal128")
 
 
 def _rescale(data: jnp.ndarray, from_scale: int, to_scale: int) -> jnp.ndarray:

@@ -1,0 +1,304 @@
+"""128-bit decimal arithmetic on 64-bit lane pairs.
+
+The reference inherits ``__int128_t`` fixed_point columns from libcudf
+(SURVEY §2.9); XLA/TPU has no 128-bit integer lane type, so a DECIMAL128
+column stores its payload as ``data[n, 2]`` int64 lanes — lane 0 is the low
+64 bits (uint64 bit pattern), lane 1 the sign-carrying high 64 bits
+(``types.decimal128``).  All arithmetic here is elementwise limb arithmetic
+on 32-bit limbs held in int64 lanes: pure VPU work, fully jittable, no
+data-dependent control flow.
+
+Two's-complement throughout: add/mul are computed mod 2^128 on unsigned
+limbs, which is exactly correct for signed values.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..column import Column
+
+_MASK32 = jnp.int64(0xFFFFFFFF)
+_TOPBIT = jnp.int64(-0x8000000000000000)   # 1 << 63 as int64 bit pattern
+
+
+# -- host construction -------------------------------------------------------
+
+def from_pyints(values, scale: int = 0) -> Column:
+    """Build a DECIMAL128 column from python ints (None ⇒ null)."""
+    n = len(values)
+    lanes = np.zeros((n, 2), dtype=np.int64)
+    valid = np.ones(n, dtype=bool)
+    for i, v in enumerate(values):
+        if v is None:
+            valid[i] = False
+            continue
+        u = int(v) & ((1 << 128) - 1)          # two's complement mod 2^128
+        lanes[i, 0] = np.int64((u & ((1 << 64) - 1)) - (1 << 64)
+                               if (u & (1 << 63)) else (u & ((1 << 64) - 1)))
+        hi = u >> 64
+        lanes[i, 1] = np.int64(hi - (1 << 64) if (hi & (1 << 63)) else hi)
+    v = None if valid.all() else jnp.asarray(valid)
+    return Column(T.decimal128(scale), jnp.asarray(lanes), validity=v)
+
+
+# -- limb decomposition ------------------------------------------------------
+
+def _limbs(lanes: jnp.ndarray) -> list[jnp.ndarray]:
+    """[n,2] int64 lane pair → four uint32 limbs held in int64 (low first)."""
+    lo, hi = lanes[:, 0], lanes[:, 1]
+    return [lo & _MASK32, (lo >> 32) & _MASK32,
+            hi & _MASK32, (hi >> 32) & _MASK32]
+
+
+def _from_limbs(l0, l1, l2, l3) -> jnp.ndarray:
+    """Carry-propagate int64 limb accumulators → [n,2] lane pair (mod 2^128)."""
+    c = l0 >> 32
+    l0 = l0 & _MASK32
+    l1 = l1 + c
+    c = l1 >> 32
+    l1 = l1 & _MASK32
+    l2 = l2 + c
+    c = l2 >> 32
+    l2 = l2 & _MASK32
+    l3 = (l3 + c) & _MASK32
+    lo = l0 | (l1 << 32)
+    hi = l2 | (l3 << 32)
+    return jnp.stack([lo, hi], axis=1)
+
+
+def _combine_validity(a: Column, b: Column):
+    if a.validity is None:
+        return b.validity
+    if b.validity is None:
+        return a.validity
+    return a.validity & b.validity
+
+
+# -- arithmetic --------------------------------------------------------------
+
+def add(a: Column, b: Column) -> Column:
+    """a + b (mod 2^128); scales must match (rescale first)."""
+    if a.dtype.scale != b.dtype.scale:
+        raise ValueError("decimal128 add requires equal scales")
+    la, lb = _limbs(a.data), _limbs(b.data)
+    out = _from_limbs(*(x + y for x, y in zip(la, lb)))
+    return Column(a.dtype, out, validity=_combine_validity(a, b))
+
+
+def negate(a: Column) -> Column:
+    l0, l1, l2, l3 = [(~x) & _MASK32 for x in _limbs(a.data)]
+    return Column(a.dtype, _from_limbs(l0 + 1, l1, l2, l3),
+                  validity=a.validity)
+
+
+def sub(a: Column, b: Column) -> Column:
+    return add(a, negate(b))
+
+
+def _mul_lanes(a_lanes: jnp.ndarray, b_limbs: list[jnp.ndarray]) -> jnp.ndarray:
+    """Full 4×4 limb product, keeping the low 4 limbs (mod 2^128).
+
+    Each partial product is uint32×uint32 ≤ 2^64-2^33+1: computed exactly in
+    uint64 then split, so int64 accumulators never overflow (≤ 8 summands of
+    < 2^32 each per limb before propagation).
+    """
+    al = _limbs(a_lanes)
+    acc = [jnp.zeros_like(al[0]) for _ in range(4)]
+    for i in range(4):
+        for j in range(4 - i):
+            p = (al[i].astype(jnp.uint64) * b_limbs[j].astype(jnp.uint64))
+            plo = (p & jnp.uint64(0xFFFFFFFF)).astype(jnp.int64)
+            phi = (p >> jnp.uint64(32)).astype(jnp.int64)
+            acc[i + j] = acc[i + j] + plo
+            if i + j + 1 < 4:
+                acc[i + j + 1] = acc[i + j + 1] + phi
+            # propagate eagerly so accumulators stay far from 2^63
+            carry = acc[i + j] >> 32
+            acc[i + j] = acc[i + j] & _MASK32
+            if i + j + 1 < 4:
+                acc[i + j + 1] = acc[i + j + 1] + carry
+    return _from_limbs(*acc)
+
+
+def _int64_limbs_signext(v: jnp.ndarray) -> list[jnp.ndarray]:
+    """int64 vector → four sign-extended uint32 limbs (two's complement)."""
+    sign = jnp.where(v < 0, _MASK32, jnp.int64(0))
+    return [v & _MASK32, (v >> 32) & _MASK32, sign, sign]
+
+
+def mul_int(a: Column, b: Column, result_scale: int | None = None) -> Column:
+    """decimal128 × integer column (elementwise), mod 2^128."""
+    bl = _int64_limbs_signext(b.data.astype(jnp.int64))
+    out = _mul_lanes(a.data, bl)
+    scale = a.dtype.scale if result_scale is None else result_scale
+    return Column(T.decimal128(scale), out, validity=_combine_validity(a, b))
+
+
+def mul(a: Column, b: Column) -> Column:
+    """decimal128 × decimal128 (mod 2^128); result scale = sum of scales."""
+    out = _mul_lanes(a.data, _limbs(b.data))
+    return Column(T.decimal128(a.dtype.scale + b.dtype.scale), out,
+                  validity=_combine_validity(a, b))
+
+
+def _negate_lanes(lanes: jnp.ndarray) -> jnp.ndarray:
+    l0, l1, l2, l3 = [(~x) & _MASK32 for x in _limbs(lanes)]
+    return _from_limbs(l0 + 1, l1, l2, l3)
+
+
+def _add_const(lanes: jnp.ndarray, c: int) -> jnp.ndarray:
+    """lanes + python-int constant (mod 2^128)."""
+    u = c & ((1 << 128) - 1)
+    climbs = [jnp.full_like(lanes[:, 0], (u >> (32 * i)) & 0xFFFFFFFF)
+              for i in range(4)]
+    return _from_limbs(*(x + y for x, y in zip(_limbs(lanes), climbs)))
+
+
+def _div_small(lanes: jnp.ndarray, d: int) -> jnp.ndarray:
+    """Truncating divide of a NON-NEGATIVE 128-bit value by d < 2^31.
+
+    Schoolbook long division over the four uint32 limbs, high→low; the
+    partial dividend r*2^32 + limb stays < 2^62 because r < d < 2^31.
+    """
+    l = _limbs(lanes)
+    q = [None] * 4
+    r = jnp.zeros_like(l[0])
+    for i in (3, 2, 1, 0):
+        cur = (r << 32) | l[i]
+        q[i] = cur // d
+        r = cur % d
+    return _from_limbs(q[0], q[1], q[2], q[3])
+
+
+def rescale(a: Column, new_scale: int) -> Column:
+    """Change scale: ×10^k toward finer scales, ÷10^k (round half away from
+    zero, Spark's decimal rescale convention — see ops/cast.py::_rescale)
+    toward coarser ones."""
+    k = a.dtype.scale - new_scale
+    lanes = a.data
+    if k >= 0:
+        while k > 0:                          # 10^9 < 2^32: limb-safe steps
+            step = min(9, k)
+            ten = jnp.full_like(a.data[:, 0], 10 ** step)
+            lanes = _mul_lanes(lanes, _int64_limbs_signext(ten))
+            k -= step
+        return Column(T.decimal128(new_scale), lanes, validity=a.validity)
+    k = -k
+    divisor = 10 ** k
+    neg = lanes[:, 1] < 0
+    mag = jnp.where(neg[:, None], _negate_lanes(lanes), lanes)
+    mag = _add_const(mag, divisor // 2)       # round half away from zero
+    while k > 0:   # truncating divide composes: ⌊⌊x/a⌋/b⌋ = ⌊x/(ab)⌋ for x≥0
+        step = min(9, k)
+        mag = _div_small(mag, 10 ** step)
+        k -= step
+    out = jnp.where(neg[:, None], _negate_lanes(mag), mag)
+    return Column(T.decimal128(new_scale), out, validity=a.validity)
+
+
+# -- comparison & sort lanes -------------------------------------------------
+
+def sort_key_lanes(a: Column, descending: bool = False) -> list[jnp.ndarray]:
+    """Lanes for jnp.lexsort, increasing priority order (lo first, hi last).
+
+    The low lane compares unsigned: flipping the top bit maps uint64 order
+    onto int64 order.
+    """
+    lo = a.data[:, 0] ^ _TOPBIT
+    hi = a.data[:, 1]
+    if descending:
+        lo, hi = ~lo, ~hi
+    return [lo, hi]
+
+
+def less_than(a: Column, b: Column) -> Column:
+    hi_lt = a.data[:, 1] < b.data[:, 1]
+    hi_eq = a.data[:, 1] == b.data[:, 1]
+    lo_lt = (a.data[:, 0] ^ _TOPBIT) < (b.data[:, 0] ^ _TOPBIT)
+    out = (hi_lt | (hi_eq & lo_lt)).astype(jnp.uint8)
+    return Column(T.bool8, out, validity=_combine_validity(a, b))
+
+
+def equal_to(a: Column, b: Column) -> Column:
+    out = ((a.data[:, 0] == b.data[:, 0]) &
+           (a.data[:, 1] == b.data[:, 1])).astype(jnp.uint8)
+    return Column(T.bool8, out, validity=_combine_validity(a, b))
+
+
+# -- reductions --------------------------------------------------------------
+
+def sum_(a: Column) -> Column:
+    """Full-column sum (mod 2^128), nulls skipped — returns a 1-row column."""
+    limbs = _limbs(a.data)
+    if a.validity is not None:
+        keep = a.validity.astype(jnp.int64)
+        limbs = [x * keep for x in limbs]
+    # 32-bit limbs summed in int64: safe for n < 2^31 rows per partial; use
+    # a two-level sum for headroom at any realistic column size.
+    sums = [jnp.sum(x.reshape(-1)) for x in limbs]
+    lanes = _from_limbs(*[s[None] for s in sums])
+    return Column(a.dtype, lanes, validity=None)
+
+
+def segmented_sum(a: Column, segment_ids: jnp.ndarray,
+                  num_segments: int) -> Column:
+    """Per-group sum (mod 2^128) — the groupby aggregation kernel."""
+    limbs = _limbs(a.data)
+    if a.validity is not None:
+        keep = a.validity.astype(jnp.int64)
+        limbs = [x * keep for x in limbs]
+    sums = [jax_segment_sum(x, segment_ids, num_segments) for x in limbs]
+    lanes = _from_limbs(*sums)
+    return Column(a.dtype, lanes, validity=None)
+
+
+def jax_segment_sum(x: jnp.ndarray, seg: jnp.ndarray, n: int) -> jnp.ndarray:
+    return jnp.zeros((n,), x.dtype).at[seg].add(x)
+
+
+# -- casts -------------------------------------------------------------------
+
+def widen(a: Column, scale: int | None = None) -> Column:
+    """decimal32/64 (or integer) column → decimal128.
+
+    Signed sources sign-extend into the high lane; unsigned sources
+    zero-extend (a UINT64 ≥ 2^63 keeps its int64 *bit pattern* in the low
+    lane but hi stays 0, preserving the value).
+    """
+    v = a.data.astype(jnp.int64)
+    if a.dtype.is_fixed_width and a.dtype.storage.kind == "u":
+        hi = jnp.zeros_like(v)
+    else:
+        hi = jnp.where(v < 0, jnp.int64(-1), jnp.int64(0))
+    lanes = jnp.stack([v, hi], axis=1)
+    if scale is None:
+        scale = a.dtype.scale if a.dtype.is_decimal else 0
+    return Column(T.decimal128(scale), lanes, validity=a.validity)
+
+
+def narrow(a: Column, to: T.DType) -> Column:
+    """decimal128 → decimal64/32 (values must fit; truncates like a C cast)."""
+    lo = a.data[:, 0]
+    return Column(to, lo.astype(jnp.dtype(to.storage)), validity=a.validity)
+
+
+def to_float64(a: Column) -> Column:
+    """decimal128 → float64 (approximate above 2^53, like cudf's cast).
+
+    Converts the two's-complement *magnitude* and reapplies the sign —
+    summing hi*2^64 + unsigned(lo) directly would cancel catastrophically
+    for small negative values (ulp(2^64) = 4096).
+    """
+    neg = a.data[:, 1] < 0
+    l0, l1, l2, l3 = [(~x) & _MASK32 for x in _limbs(a.data)]
+    negated = _from_limbs(l0 + 1, l1, l2, l3)
+    mag = jnp.where(neg[:, None], negated, a.data)
+    lo, hi = mag[:, 0], mag[:, 1]
+    loval = lo.astype(jnp.float64) + jnp.where(lo < 0, 2.0 ** 64, 0.0)
+    hival = hi.astype(jnp.float64) + jnp.where(hi < 0, 2.0 ** 64, 0.0)
+    val = hival * (2.0 ** 64) + loval
+    val = jnp.where(neg, -val, val) * (10.0 ** a.dtype.scale)
+    return Column(T.float64, val, validity=a.validity)

@@ -17,23 +17,36 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import types as T
 from ..column import Column, Table
+
+
+def _segment_gather(offs: jnp.ndarray, idx: jnp.ndarray):
+    """Element indices + new offsets for gathering variable-width segments."""
+    lens = (offs[1:] - offs[:-1])[idx]
+    new_offs = jnp.concatenate([jnp.zeros(1, lens.dtype), jnp.cumsum(lens)])
+    total = int(new_offs[-1])
+    starts = offs[:-1][idx]
+    elem_ids = jnp.arange(total, dtype=jnp.int64)
+    row_of = jnp.searchsorted(new_offs.astype(jnp.int64), elem_ids,
+                              side="right") - 1
+    src = starts.astype(jnp.int64)[row_of] + (
+        elem_ids - new_offs.astype(jnp.int64)[row_of])
+    return src, new_offs.astype(jnp.int32)
 
 
 def _gather_column(col: Column, idx: jnp.ndarray) -> Column:
     v = None if col.validity is None else col.validity[idx]
-    if col.dtype.is_variable_width:
-        offs = col.offsets
-        lens = (offs[1:] - offs[:-1])[idx]
-        new_offs = jnp.concatenate([jnp.zeros(1, lens.dtype), jnp.cumsum(lens)])
-        total = int(new_offs[-1])
-        starts = offs[:-1][idx]
-        char_ids = jnp.arange(total, dtype=jnp.int64)
-        row_of = jnp.searchsorted(new_offs.astype(jnp.int64), char_ids,
-                                  side="right") - 1
-        src = starts.astype(jnp.int64)[row_of] + (
-            char_ids - new_offs.astype(jnp.int64)[row_of])
-        return Column(col.dtype, col.data[src], new_offs.astype(jnp.int32), v)
+    if col.dtype.id == T.TypeId.STRUCT:
+        return Column(col.dtype, col.data, None, v,
+                      [_gather_column(ch, idx) for ch in col.children])
+    if col.dtype.id == T.TypeId.LIST:
+        src, new_offs = _segment_gather(col.offsets, idx)
+        return Column(col.dtype, col.data, new_offs, v,
+                      [_gather_column(col.children[0], src)])
+    if col.dtype.is_variable_width:   # STRING: chars live in .data
+        src, new_offs = _segment_gather(col.offsets, idx)
+        return Column(col.dtype, col.data[src], new_offs, v)
     return Column(col.dtype, col.data[idx], validity=v)
 
 
@@ -57,5 +70,5 @@ def mask_table(table: Table, mask: jnp.ndarray) -> Table:
     cols = []
     for c in table.columns:
         v = mask if c.validity is None else (c.validity & mask)
-        cols.append(Column(c.dtype, c.data, c.offsets, v))
+        cols.append(Column(c.dtype, c.data, c.offsets, v, c.children))
     return Table(cols)

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import types as T
 from ..column import Column, Table
 from .filter import gather
 from .sort import order_by
@@ -115,6 +116,13 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
 
     for vi, agg in aggs:
         col = sorted_tbl[vi]
+        if col.dtype.id == T.TypeId.DECIMAL128:
+            if agg != "sum":
+                raise NotImplementedError(
+                    f"decimal128 groupby supports sum only, got {agg!r}")
+            from . import decimal128 as d128
+            out_cols.append(d128.segmented_sum(col, seg_ids, num_segments))
+            continue
         res = _agg_segment(col.data, col.validity, seg_ids, agg,
                            num_segments, col.dtype.storage.kind)
         # min/max of an all-null group is null

@@ -31,6 +31,9 @@ def order_by(table: Table, keys: Sequence[int],
             # increasing-priority order for lexsort
             from . import strings
             key_lanes = strings.sort_key_lanes(col, descending=not asc)
+        elif col.dtype.id.name == "DECIMAL128":
+            from . import decimal128 as d128
+            key_lanes = d128.sort_key_lanes(col, descending=not asc)
         else:
             data = col.data
             if not asc:

@@ -65,6 +65,7 @@ class TypeId(enum.IntEnum):
     STRING = 24
     LIST = 25
     STRUCT = 26
+    DECIMAL128 = 27
 
 
 # Storage dtype (the JAX/numpy dtype holding the column's fixed-width payload).
@@ -111,10 +112,22 @@ class DType:
 
     id: TypeId
     scale: int = 0
+    # Element/field types for nested columns: LIST has exactly one child (the
+    # element type), STRUCT has one child per field.  Mirrors the cudf
+    # lists/structs column hierarchy the reference builds on
+    # (``row_conversion.cu:1264`` make_lists_column; SURVEY §2.9).
+    children: tuple = ()
 
     def __post_init__(self):
-        if self.scale != 0 and self.id not in (TypeId.DECIMAL32, TypeId.DECIMAL64):
+        if self.scale != 0 and self.id not in (
+                TypeId.DECIMAL32, TypeId.DECIMAL64, TypeId.DECIMAL128):
             raise ValueError(f"scale only valid for decimal types, got {self.id!r}")
+        if self.id == TypeId.LIST and len(self.children) != 1:
+            raise ValueError("LIST dtype requires exactly one child (element) type")
+        if self.id == TypeId.STRUCT and not self.children:
+            raise ValueError("STRUCT dtype requires at least one field type")
+        if self.children and self.id not in (TypeId.LIST, TypeId.STRUCT):
+            raise ValueError(f"children only valid for nested types, got {self.id!r}")
 
     # -- classification -----------------------------------------------------
     @property
@@ -127,7 +140,11 @@ class DType:
 
     @property
     def is_decimal(self) -> bool:
-        return self.id in (TypeId.DECIMAL32, TypeId.DECIMAL64)
+        return self.id in (TypeId.DECIMAL32, TypeId.DECIMAL64, TypeId.DECIMAL128)
+
+    @property
+    def is_nested(self) -> bool:
+        return self.id in (TypeId.LIST, TypeId.STRUCT)
 
     @property
     def is_timestamp(self) -> bool:
@@ -204,6 +221,29 @@ def decimal32(scale: int) -> DType:
 
 def decimal64(scale: int) -> DType:
     return DType(TypeId.DECIMAL64, scale)
+
+
+def decimal128(scale: int) -> DType:
+    """128-bit decimal.
+
+    JAX/XLA has no int128 lane type, so the payload is stored as two int64
+    lanes per row — ``data`` is [n, 2] with column 0 = low 64 bits (as the
+    int64 bit pattern of the uint64 low word) and column 1 = high 64 bits
+    (sign-carrying).  All arithmetic is done on the lane pair with explicit
+    carries (``ops/decimal128.py``) — a TPU-native stand-in for cudf's
+    ``__int128_t`` fixed_point columns.
+    """
+    return DType(TypeId.DECIMAL128, scale)
+
+
+def list_(element: DType) -> DType:
+    """LIST type (Arrow/cudf lists column: int32 offsets + child column)."""
+    return DType(TypeId.LIST, 0, (element,))
+
+
+def struct_(*fields: DType) -> DType:
+    """STRUCT type (cudf structs column: parallel child columns)."""
+    return DType(TypeId.STRUCT, 0, tuple(fields))
 
 
 def from_numpy(dt: np.dtype) -> DType:

@@ -1,0 +1,210 @@
+"""DECIMAL128 lane-pair arithmetic, differential vs Python big-int oracle.
+
+The reference gets __int128 fixed_point columns from libcudf (SURVEY §2.9);
+here the payload is [n,2] int64 lanes with explicit limb arithmetic
+(ops/decimal128.py).  Every op is checked against exact Python integers,
+reduced mod 2^128 into the signed range.
+"""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import types as T
+from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu.ops import cast, decimal128 as d128, groupby_aggregate
+from spark_rapids_jni_tpu.ops.sort import sort_table
+
+_TWO127 = 1 << 127
+
+
+def _signed_mod(v: int) -> int:
+    """Reduce an int into the signed 128-bit range (two's complement)."""
+    v &= (1 << 128) - 1
+    return v - (1 << 128) if v >= _TWO127 else v
+
+
+def _rand_ints(n, bits=120, seed=0):
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n):
+        mag = rng.getrandbits(rng.randrange(1, bits))
+        out.append(mag if rng.random() < 0.5 else -mag)
+    return out
+
+
+class TestRoundTrip:
+    def test_small_and_large(self):
+        vals = [0, 1, -1, 2**64, -(2**64), 2**127 - 1, -(2**127), None, 12345]
+        col = d128.from_pyints(vals)
+        assert col.to_pylist() == vals
+
+    def test_random(self):
+        vals = _rand_ints(200)
+        assert d128.from_pyints(vals).to_pylist() == vals
+
+
+class TestArithmetic:
+    def test_add_sub(self):
+        a_v, b_v = _rand_ints(300, seed=1), _rand_ints(300, seed=2)
+        a, b = d128.from_pyints(a_v), d128.from_pyints(b_v)
+        got = d128.add(a, b).to_pylist()
+        assert got == [_signed_mod(x + y) for x, y in zip(a_v, b_v)]
+        got = d128.sub(a, b).to_pylist()
+        assert got == [_signed_mod(x - y) for x, y in zip(a_v, b_v)]
+
+    def test_add_null_propagation(self):
+        a = d128.from_pyints([1, None, 3])
+        b = d128.from_pyints([10, 20, None])
+        assert d128.add(a, b).to_pylist() == [11, None, None]
+
+    def test_negate(self):
+        vals = _rand_ints(100, seed=3) + [0, -(2**127)]
+        got = d128.negate(d128.from_pyints(vals)).to_pylist()
+        assert got == [_signed_mod(-v) for v in vals]
+
+    def test_mul_int(self):
+        a_v = _rand_ints(200, bits=100, seed=4)
+        b_v = [random.Random(5).randrange(-2**62, 2**62) for _ in a_v]
+        a = d128.from_pyints(a_v)
+        b = Column.from_numpy(np.asarray(b_v, np.int64))
+        got = d128.mul_int(a, b).to_pylist()
+        assert got == [_signed_mod(x * y) for x, y in zip(a_v, b_v)]
+
+    def test_mul_full(self):
+        a_v, b_v = _rand_ints(200, seed=6), _rand_ints(200, seed=7)
+        a = d128.from_pyints(a_v, scale=-2)
+        b = d128.from_pyints(b_v, scale=-3)
+        res = d128.mul(a, b)
+        assert res.dtype.scale == -5
+        assert res.to_pylist() == [_signed_mod(x * y)
+                                   for x, y in zip(a_v, b_v)]
+
+    def test_rescale(self):
+        vals = _rand_ints(50, bits=60, seed=8)
+        col = d128.from_pyints(vals, scale=0)
+        out = d128.rescale(col, -11)
+        assert out.dtype.scale == -11
+        assert out.to_pylist() == [_signed_mod(v * 10**11) for v in vals]
+
+    def test_rescale_down_rounds_half_away(self):
+        col = d128.from_pyints([12345, 12344, -12345, -12344, 2**100 + 50],
+                               scale=-2)
+        out = d128.rescale(col, -1)
+        assert out.dtype.scale == -1
+        assert out.to_pylist() == [1235, 1234, -1235, -1234,
+                                   (2**100 + 50 + 5) // 10]
+
+    def test_rescale_down_large_k(self):
+        vals = _rand_ints(50, bits=120, seed=20)
+        col = d128.from_pyints(vals, scale=0)
+        out = d128.rescale(col, 25)
+        d = 10**25
+        want = [(abs(v) + d // 2) // d * (1 if v >= 0 else -1) for v in vals]
+        assert out.to_pylist() == want
+
+
+class TestReductions:
+    def test_sum(self):
+        vals = _rand_ints(500, seed=9)
+        got = d128.sum_(d128.from_pyints(vals)).to_pylist()
+        assert got == [_signed_mod(sum(vals))]
+
+    def test_sum_skips_nulls(self):
+        vals = [5, None, 7, None, -2]
+        got = d128.sum_(d128.from_pyints(vals)).to_pylist()
+        assert got == [10]
+
+    def test_segmented_sum(self):
+        vals = _rand_ints(100, seed=10)
+        seg = np.sort(np.random.RandomState(0).randint(0, 5, size=100))
+        col = d128.from_pyints(vals)
+        got = d128.segmented_sum(col, jnp.asarray(seg), 5).to_pylist()
+        want = [_signed_mod(sum(v for v, s in zip(vals, seg) if s == g))
+                for g in range(5)]
+        assert got == want
+
+
+class TestCompareSort:
+    def test_less_than(self):
+        a_v, b_v = _rand_ints(300, seed=11), _rand_ints(300, seed=12)
+        a, b = d128.from_pyints(a_v), d128.from_pyints(b_v)
+        got = d128.less_than(a, b).to_pylist()
+        assert got == [x < y for x, y in zip(a_v, b_v)]
+
+    def test_sort(self):
+        vals = _rand_ints(200, seed=13)
+        t = sort_table(Table([d128.from_pyints(vals)]), [0])
+        assert t[0].to_pylist() == sorted(vals)
+        t = sort_table(Table([d128.from_pyints(vals)]), [0], ascending=[False])
+        assert t[0].to_pylist() == sorted(vals, reverse=True)
+
+
+class TestCasts:
+    def test_widen_int64(self):
+        vals = [0, 1, -1, 2**62, -(2**62), None]
+        col = Column.from_numpy(np.asarray([0 if v is None else v for v in vals],
+                                           np.int64),
+                                validity=np.asarray([v is not None for v in vals]))
+        got = cast(col, T.decimal128(0)).to_pylist()
+        assert got == vals
+
+    def test_widen_decimal64_rescale(self):
+        col = Column.from_numpy(np.asarray([123, -45], np.int64),
+                                T.decimal64(-2))
+        out = cast(col, T.decimal128(-4))
+        assert out.to_pylist() == [12300, -4500]
+
+    def test_narrow_back(self):
+        col = d128.from_pyints([123456, -789], scale=-2)
+        out = cast(col, T.decimal64(-2))
+        assert out.dtype == T.decimal64(-2)
+        assert out.to_pylist() == [123456, -789]
+
+    def test_to_float64(self):
+        col = d128.from_pyints([12345, -67890, 2**70], scale=-2)
+        got = np.asarray(cast(col, T.float64).data)
+        want = np.asarray([123.45, -678.90, float(2**70) * 1e-2])
+        np.testing.assert_allclose(got, want, rtol=1e-12)
+
+    def test_float_to_decimal128(self):
+        col = Column.from_numpy(np.asarray([1.25, -3.5], np.float64))
+        got = cast(col, T.decimal128(-2)).to_pylist()
+        assert got == [125, -350]
+
+    def test_float_to_decimal128_large(self):
+        # values whose scaled magnitude exceeds 2^63 must not wrap; the
+        # result is the exact integer value of the (nearest-double) input
+        col = Column.from_numpy(np.asarray([1e20, -1e24, 1e30], np.float64))
+        got = cast(col, T.decimal128(0)).to_pylist()
+        assert got == [int(np.float64(1e20)), -int(np.float64(1e24)),
+                       int(np.float64(1e30))]
+
+    def test_uint64_above_2_63_widens_unsigned(self):
+        col = Column.from_numpy(np.asarray([2**63, 2**64 - 1], np.uint64))
+        got = cast(col, T.decimal128(0)).to_pylist()
+        assert got == [2**63, 2**64 - 1]
+
+    def test_narrow_scale_reduction(self):
+        # decimal128(-2) → decimal64(0) divides with round-half-away,
+        # matching the decimal64 _rescale path
+        col = d128.from_pyints([12345, -12355], scale=-2)
+        out = cast(col, T.decimal64(0))
+        assert out.to_pylist() == [123, -124]
+
+
+class TestGroupby:
+    def test_groupby_sum_decimal128(self):
+        keys = Column.from_numpy(np.asarray([1, 2, 1, 2, 1], np.int32))
+        vals = d128.from_pyints([2**100, 5, 2**100, -6, 1])
+        out = groupby_aggregate(Table([keys, vals]), [0], [(1, "sum")])
+        assert out[0].to_pylist() == [1, 2]
+        assert out[1].to_pylist() == [_signed_mod(2**101 + 1), -1]
+
+    def test_groupby_decimal128_non_sum_rejected(self):
+        keys = Column.from_numpy(np.asarray([1], np.int32))
+        vals = d128.from_pyints([1])
+        with pytest.raises(NotImplementedError):
+            groupby_aggregate(Table([keys, vals]), [0], [(1, "min")])
