@@ -13,7 +13,6 @@ never cross the C boundary.
 from __future__ import annotations
 
 import ctypes as C
-import os
 
 import numpy as np
 
@@ -22,63 +21,13 @@ from .column import Column, Table
 from .rowconv import convert_from_rows, convert_to_rows
 from .rowconv.convert import RowBatch
 
-_lib = None
-
-
 def _load() -> C.CDLL:
-    global _lib
-    if _lib is None:
-        path = os.path.join(os.path.dirname(__file__), "native", "libsrjt.so")
-        lib = C.CDLL(path)
-        lib.srjt_table_rows.restype = C.c_int64
-        lib.srjt_table_rows.argtypes = [C.c_void_p]
-        lib.srjt_table_cols.restype = C.c_int32
-        lib.srjt_table_cols.argtypes = [C.c_void_p]
-        lib.srjt_table_column.restype = C.c_void_p
-        lib.srjt_table_column.argtypes = [C.c_void_p, C.c_int32]
-        lib.srjt_column_type.restype = C.c_int32
-        lib.srjt_column_type.argtypes = [C.c_void_p]
-        lib.srjt_column_scale.restype = C.c_int32
-        lib.srjt_column_scale.argtypes = [C.c_void_p]
-        lib.srjt_column_rows.restype = C.c_int64
-        lib.srjt_column_rows.argtypes = [C.c_void_p]
-        lib.srjt_column_data.restype = C.POINTER(C.c_uint8)
-        lib.srjt_column_data.argtypes = [C.c_void_p]
-        lib.srjt_column_data_size.restype = C.c_int64
-        lib.srjt_column_data_size.argtypes = [C.c_void_p]
-        lib.srjt_column_offsets.restype = C.POINTER(C.c_int32)
-        lib.srjt_column_offsets.argtypes = [C.c_void_p]
-        lib.srjt_column_valid.restype = C.POINTER(C.c_uint8)
-        lib.srjt_column_valid.argtypes = [C.c_void_p]
-        lib.srjt_column_fixed.restype = C.c_void_p
-        lib.srjt_column_fixed.argtypes = [C.c_int32, C.c_int32, C.c_int64,
-                                          C.c_void_p, C.c_void_p]
-        lib.srjt_column_string.restype = C.c_void_p
-        lib.srjt_column_string.argtypes = [C.c_int64, C.c_void_p, C.c_void_p,
-                                           C.c_void_p]
-        lib.srjt_column_free.argtypes = [C.c_void_p]
-        lib.srjt_table.restype = C.c_void_p
-        lib.srjt_table.argtypes = [C.POINTER(C.c_void_p), C.c_int32]
-        lib.srjt_rows_import.restype = C.c_void_p
-        lib.srjt_rows_import.argtypes = [C.c_void_p, C.c_int64, C.c_void_p,
-                                         C.c_int64]
-        lib.srjt_rows_import_append.restype = C.c_int32
-        lib.srjt_rows_import_append.argtypes = [C.c_void_p, C.c_void_p,
-                                                C.c_int64, C.c_void_p,
-                                                C.c_int64]
-        lib.srjt_rows_num_batches.restype = C.c_int32
-        lib.srjt_rows_num_batches.argtypes = [C.c_void_p]
-        lib.srjt_rows_batch_rows.restype = C.c_int64
-        lib.srjt_rows_batch_rows.argtypes = [C.c_void_p, C.c_int32]
-        lib.srjt_rows_batch_data.restype = C.POINTER(C.c_uint8)
-        lib.srjt_rows_batch_data.argtypes = [C.c_void_p, C.c_int32]
-        lib.srjt_rows_batch_size.restype = C.c_int64
-        lib.srjt_rows_batch_size.argtypes = [C.c_void_p, C.c_int32]
-        lib.srjt_rows_batch_offsets.restype = C.POINTER(C.c_int32)
-        lib.srjt_rows_batch_offsets.argtypes = [C.c_void_p, C.c_int32]
-        lib.srjt_rows_free.argtypes = [C.c_void_p]
-        _lib = lib
-    return _lib
+    # single shared binding site for the whole libsrjt C ABI
+    from . import native
+    lib = native.load()
+    if lib is None:
+        raise OSError("libsrjt.so unavailable")
+    return lib
 
 
 def _np_from_ptr(ptr, n, ctype):
@@ -161,6 +110,8 @@ def from_rows_from_handle(rows_handle: int, type_ids_ptr: int,
                           scales_ptr: int, ncols: int) -> int:
     """RowBatches handle + schema arrays → host table handle via the
     DEVICE engine (batch 0, matching the one-batch contract)."""
+    handles: list = []
+    lib = None
     try:
         import jax.numpy as jnp
         lib = _load()
@@ -184,7 +135,6 @@ def from_rows_from_handle(rows_handle: int, type_ids_ptr: int,
         batch = RowBatch(jnp.asarray(data), jnp.asarray(offs))
         table = convert_from_rows(batch, schema)
 
-        handles = []
         keepalive = []
         for col in table.columns:
             valid_ptr = None
@@ -218,4 +168,9 @@ def from_rows_from_handle(rows_handle: int, type_ids_ptr: int,
             lib.srjt_column_free(hh)
         return int(out or 0)
     except Exception:
+        # free any column handles created before the failure (the to-rows
+        # path has the same partial-cleanup contract)
+        if lib is not None:
+            for hh in handles:
+                lib.srjt_column_free(hh)
         return 0

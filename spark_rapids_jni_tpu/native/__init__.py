@@ -72,6 +72,43 @@ def _bind(lib: ctypes.CDLL) -> None:
     _sig(lib, "srjt_gather_chars", None,
          [p_u8, p_i64, i64, i32, p_i32, p_u8])
 
+    # host table / column ABI (native/host_table.cpp) — the single binding
+    # site shared by bridge.py and the test suites; keep in sync with
+    # cpp declarations in jni_min.h/host_table.cpp
+    vp = _c.c_void_p
+    _sig(lib, "srjt_column_fixed", vp, [i32, i32, i64, vp, vp])
+    _sig(lib, "srjt_column_string", vp, [i64, vp, vp, vp])
+    _sig(lib, "srjt_column_free", None, [vp])
+    _sig(lib, "srjt_column_type", i32, [vp])
+    _sig(lib, "srjt_column_scale", i32, [vp])
+    _sig(lib, "srjt_column_rows", i64, [vp])
+    _sig(lib, "srjt_column_data", p_u8, [vp])
+    _sig(lib, "srjt_column_data_size", i64, [vp])
+    _sig(lib, "srjt_column_offsets", p_i32, [vp])
+    _sig(lib, "srjt_column_valid", p_u8, [vp])
+    _sig(lib, "srjt_table", vp, [pp, i32])
+    _sig(lib, "srjt_table_free", None, [vp])
+    _sig(lib, "srjt_table_rows", i64, [vp])
+    _sig(lib, "srjt_table_cols", i32, [vp])
+    _sig(lib, "srjt_table_column", vp, [vp, i32])
+    _sig(lib, "srjt_to_rows", vp, [vp])
+    # pointer args typed c_void_p: call sites pass numpy .ctypes pointers
+    _sig(lib, "srjt_from_rows", vp, [vp, i32, vp, vp, i32])
+    _sig(lib, "srjt_debug_set_max_batch_bytes", None, [i64])
+    _sig(lib, "srjt_rows_import", vp, [vp, i64, vp, i64])
+    _sig(lib, "srjt_rows_import_append", i32, [vp, vp, i64, vp, i64])
+    _sig(lib, "srjt_rows_free", None, [vp])
+    _sig(lib, "srjt_rows_num_batches", i32, [vp])
+    _sig(lib, "srjt_rows_batch_rows", i64, [vp, i32])
+    _sig(lib, "srjt_rows_batch_data", p_u8, [vp, i32])
+    _sig(lib, "srjt_rows_batch_size", i64, [vp, i32])
+    _sig(lib, "srjt_rows_batch_offsets", p_i32, [vp, i32])
+
+    # device bridge (native/device_bridge.cpp)
+    _sig(lib, "srjt_device_available", i32, [])
+    _sig(lib, "srjt_to_rows_device", vp, [vp])
+    _sig(lib, "srjt_from_rows_device", vp, [vp, vp, vp, i32])
+
 
 def load() -> Optional[ctypes.CDLL]:
     """Load (building if needed) libsrjt.so; None if unavailable."""

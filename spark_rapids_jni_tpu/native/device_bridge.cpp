@@ -18,6 +18,7 @@
 // host engine).
 
 #include <cstdint>
+#include <cstdlib>
 #include <dlfcn.h>
 #include <mutex>
 
@@ -80,9 +81,19 @@ const PyApi& py_api() {
   return api;
 }
 
+// Runtime kill switch (same convention as the Pallas dispatch's
+// SRJT_PALLAS toggle): SRJT_DEVICE=0 forces the host C++ engine even when
+// an embedded runtime is reachable — the operator escape hatch for
+// non-TPU executors where the "device" path is just slower.
+bool device_disabled() {
+  const char* v = std::getenv("SRJT_DEVICE");
+  return v && v[0] == '0' && v[1] == '\0';
+}
+
 // call spark_rapids_jni_tpu.bridge.<fn>(handle) → int64 result handle
 void* call_bridge(const char* fn, void* handle, const int32_t* type_ids,
                   const int32_t* scales, int32_t ncols) {
+  if (device_disabled()) return nullptr;
   const PyApi& py = py_api();
   if (!py.ok || !py.is_initialized()) return nullptr;
   int gil = py.gil_ensure();
@@ -124,6 +135,7 @@ extern "C" {
 // 1 when an initialized CPython runtime (and thus the JAX device engine)
 // is reachable from this process.
 int32_t srjt_device_available() {
+  if (device_disabled()) return 0;
   const PyApi& py = py_api();
   return (py.ok && py.is_initialized()) ? 1 : 0;
 }

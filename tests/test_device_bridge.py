@@ -22,42 +22,10 @@ if not os.path.exists(_LIB):
 # the bridge module must resolve the SAME library instance
 import spark_rapids_jni_tpu  # noqa: F401  (initializes jax/x64)
 
-lib = C.CDLL(_LIB)
-lib.srjt_column_fixed.restype = C.c_void_p
-lib.srjt_column_fixed.argtypes = [C.c_int32, C.c_int32, C.c_int64,
-                                  C.c_void_p, C.c_void_p]
-lib.srjt_column_string.restype = C.c_void_p
-lib.srjt_column_string.argtypes = [C.c_int64, C.c_void_p, C.c_void_p,
-                                   C.c_void_p]
-lib.srjt_column_free.argtypes = [C.c_void_p]
-lib.srjt_table.restype = C.c_void_p
-lib.srjt_table.argtypes = [C.POINTER(C.c_void_p), C.c_int32]
-lib.srjt_table_free.argtypes = [C.c_void_p]
-lib.srjt_to_rows.restype = C.c_void_p
-lib.srjt_to_rows.argtypes = [C.c_void_p]
-lib.srjt_to_rows_device.restype = C.c_void_p
-lib.srjt_to_rows_device.argtypes = [C.c_void_p]
-lib.srjt_from_rows_device.restype = C.c_void_p
-lib.srjt_from_rows_device.argtypes = [C.c_void_p, C.c_void_p, C.c_void_p,
-                                      C.c_int32]
-lib.srjt_device_available.restype = C.c_int32
-lib.srjt_rows_free.argtypes = [C.c_void_p]
-lib.srjt_rows_num_batches.restype = C.c_int32
-lib.srjt_rows_num_batches.argtypes = [C.c_void_p]
-lib.srjt_rows_batch_data.restype = C.POINTER(C.c_uint8)
-lib.srjt_rows_batch_data.argtypes = [C.c_void_p, C.c_int32]
-lib.srjt_rows_batch_size.restype = C.c_int64
-lib.srjt_rows_batch_size.argtypes = [C.c_void_p, C.c_int32]
-lib.srjt_table_cols.restype = C.c_int32
-lib.srjt_table_cols.argtypes = [C.c_void_p]
-lib.srjt_table_rows.restype = C.c_int64
-lib.srjt_table_rows.argtypes = [C.c_void_p]
-lib.srjt_table_column.restype = C.c_void_p
-lib.srjt_table_column.argtypes = [C.c_void_p, C.c_int32]
-lib.srjt_column_data.restype = C.POINTER(C.c_uint8)
-lib.srjt_column_data.argtypes = [C.c_void_p]
-lib.srjt_column_data_size.restype = C.c_int64
-lib.srjt_column_data_size.argtypes = [C.c_void_p]
+from spark_rapids_jni_tpu import native as _native
+
+lib = _native.load()   # single shared binding site (native/__init__.py)
+assert lib is not None
 
 INT32, INT64, STRING = 3, 4, 24
 
@@ -130,3 +98,20 @@ def test_from_rows_device_roundtrip():
     lib.srjt_rows_free(rows)
     lib.srjt_table_free(t)
     lib.srjt_table_free(back)
+
+
+def test_srjt_device_kill_switch(monkeypatch):
+    # SRJT_DEVICE=0 is the operator escape hatch forcing the host engine
+    # (same convention as the SRJT_PALLAS dispatch toggle); getenv is read
+    # per call, so flipping the env var takes effect immediately
+    assert lib.srjt_device_available() == 1
+    monkeypatch.setenv("SRJT_DEVICE", "0")
+    assert lib.srjt_device_available() == 0
+    t, _ = _mixed_table(16)
+    assert not lib.srjt_to_rows_device(t)
+    monkeypatch.delenv("SRJT_DEVICE")
+    assert lib.srjt_device_available() == 1
+    rows = lib.srjt_to_rows_device(t)
+    assert rows
+    lib.srjt_rows_free(rows)
+    lib.srjt_table_free(t)

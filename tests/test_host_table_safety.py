@@ -19,29 +19,10 @@ _LIB = os.path.join(os.path.dirname(__file__), "..",
 if not os.path.exists(_LIB):
     pytest.skip("libsrjt.so not built", allow_module_level=True)
 
-lib = C.CDLL(_LIB)
+from spark_rapids_jni_tpu import native as _native
 
-lib.srjt_column_fixed.restype = C.c_void_p
-lib.srjt_column_fixed.argtypes = [C.c_int32, C.c_int32, C.c_int64,
-                                  C.c_void_p, C.c_void_p]
-lib.srjt_column_string.restype = C.c_void_p
-lib.srjt_column_string.argtypes = [C.c_int64, C.c_void_p, C.c_void_p,
-                                   C.c_void_p]
-lib.srjt_column_free.argtypes = [C.c_void_p]
-lib.srjt_table.restype = C.c_void_p
-lib.srjt_table.argtypes = [C.c_void_p, C.c_int32]
-lib.srjt_table_free.argtypes = [C.c_void_p]
-lib.srjt_to_rows.restype = C.c_void_p
-lib.srjt_to_rows.argtypes = [C.c_void_p]
-lib.srjt_rows_free.argtypes = [C.c_void_p]
-lib.srjt_rows_import.restype = C.c_void_p
-lib.srjt_rows_import.argtypes = [C.c_void_p, C.c_int64, C.c_void_p,
-                                 C.c_int64]
-lib.srjt_from_rows.restype = C.c_void_p
-lib.srjt_from_rows.argtypes = [C.c_void_p, C.c_int32, C.c_void_p,
-                               C.c_void_p, C.c_int32]
-lib.srjt_table_free.argtypes = [C.c_void_p]
-lib.srjt_debug_set_max_batch_bytes.argtypes = [C.c_int64]
+lib = _native.load()   # single shared binding site (native/__init__.py)
+assert lib is not None
 
 INT32, STRING = 3, 24
 
@@ -127,10 +108,6 @@ def test_from_rows_rejects_out_of_row_string_slot():
     t = _string_table(chars_per_row=8, n=1)
     rows = lib.srjt_to_rows(t)
     assert rows
-    lib.srjt_rows_batch_data.restype = C.POINTER(C.c_uint8)
-    lib.srjt_rows_batch_data.argtypes = [C.c_void_p, C.c_int32]
-    lib.srjt_rows_batch_size.restype = C.c_int64
-    lib.srjt_rows_batch_size.argtypes = [C.c_void_p, C.c_int32]
     size = lib.srjt_rows_batch_size(rows, 0)
     buf = np.ctypeslib.as_array(lib.srjt_rows_batch_data(rows, 0),
                                 shape=(size,)).copy()
