@@ -2,8 +2,11 @@ from . import hashing  # noqa: F401
 from . import strings  # noqa: F401
 from .cast import cast  # noqa: F401
 from .filter import apply_boolean_mask, gather, mask_table  # noqa: F401
-from .groupby import groupby_aggregate  # noqa: F401
+from .copying import concat_tables, slice_table  # noqa: F401
+from .groupby import distinct, groupby_aggregate  # noqa: F401
 from .join import (anti_join, inner_join, join_indices, left_join,  # noqa: F401
                    semi_join)
+from .scan import (cumulative_count, cumulative_max,  # noqa: F401
+                   cumulative_min, cumulative_sum)
 from .reductions import max_, mean, min_, sum_, valid_count  # noqa: F401
 from .sort import order_by, sort_table  # noqa: F401

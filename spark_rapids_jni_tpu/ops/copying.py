@@ -1,0 +1,90 @@
+"""Table copy operations: concatenate and slice.
+
+The reference gets these from libcudf (``cudf::concatenate``,
+``cudf::slice`` — SURVEY §2.9); here they are thin, fully device-side
+compositions: concatenation is per-column buffer concat with offset
+rebasing, slicing is a static-bound buffer slice (XLA wants static shapes,
+and Spark partitions give static bounds at plan time).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from .. import types as T
+from ..column import Column, Table
+
+
+def _concat_validity(cols: Sequence[Column]):
+    if all(c.validity is None for c in cols):
+        return None
+    return jnp.concatenate([c.validity_or_true() for c in cols])
+
+
+def _concat_columns(cols: Sequence[Column]) -> Column:
+    dt = cols[0].dtype
+    for c in cols:
+        if c.dtype != dt:
+            raise TypeError(f"concat dtype mismatch: {c.dtype} vs {dt}")
+    v = _concat_validity(cols)
+    if dt.id == T.TypeId.STRUCT:
+        children = [_concat_columns([c.children[i] for c in cols])
+                    for i in range(len(dt.children))]
+        return Column(dt, cols[0].data, None, v, children)
+    if dt.id == T.TypeId.LIST:
+        child = _concat_columns([c.children[0] for c in cols])
+        offs = _rebase_offsets(cols)
+        return Column(dt, cols[0].data, offs, v, [child])
+    if dt.is_variable_width:    # STRING: chars live in .data
+        chars = jnp.concatenate([c.data for c in cols])
+        return Column(dt, chars, _rebase_offsets(cols), v)
+    return Column(dt, jnp.concatenate([c.data for c in cols]), validity=v)
+
+
+def _rebase_offsets(cols: Sequence[Column]) -> jnp.ndarray:
+    parts = [cols[0].offsets]
+    base = cols[0].offsets[-1]
+    for c in cols[1:]:
+        parts.append(c.offsets[1:] + base)
+        base = base + c.offsets[-1]
+    return jnp.concatenate(parts)
+
+
+def concat_tables(tables: Sequence[Table]) -> Table:
+    """Row-wise concatenation (cudf::concatenate analog)."""
+    tables = list(tables)
+    if not tables:
+        raise ValueError("concat_tables needs at least one table")
+    ncols = tables[0].num_columns
+    for t in tables:
+        if t.num_columns != ncols:
+            raise ValueError("concat_tables: column count mismatch")
+    return Table([_concat_columns([t[i] for t in tables])
+                  for i in range(ncols)])
+
+
+def _slice_column(col: Column, start: int, stop: int) -> Column:
+    v = None if col.validity is None else col.validity[start:stop]
+    if col.dtype.id == T.TypeId.STRUCT:
+        return Column(col.dtype, col.data, None, v,
+                      [_slice_column(ch, start, stop) for ch in col.children])
+    if col.dtype.id == T.TypeId.LIST:
+        offs = col.offsets[start:stop + 1]
+        c0, c1 = int(offs[0]), int(offs[-1])
+        return Column(col.dtype, col.data, offs - offs[0], v,
+                      [_slice_column(col.children[0], c0, c1)])
+    if col.dtype.is_variable_width:
+        offs = col.offsets[start:stop + 1]
+        c0, c1 = int(offs[0]), int(offs[-1])
+        return Column(col.dtype, col.data[c0:c1], offs - offs[0], v)
+    return Column(col.dtype, col.data[start:stop], validity=v)
+
+
+def slice_table(table: Table, start: int, length: int | None = None) -> Table:
+    """Zero-based row slice with static host bounds (cudf::slice analog)."""
+    n = table.num_rows
+    start = max(0, min(start, n))
+    stop = n if length is None else max(start, min(start + length, n))
+    return Table([_slice_column(c, start, stop) for c in table.columns])

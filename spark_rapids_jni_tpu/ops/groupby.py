@@ -85,6 +85,10 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
     str_dicts: dict[int, Column] = {}
     work_cols = list(table.columns)
     for ki in key_indices:
+        if table[ki].dtype.is_nested:
+            raise NotImplementedError(
+                f"{table[ki].dtype.id.name} columns cannot be groupby/"
+                "distinct keys")
         if table[ki].dtype.is_variable_width:
             from . import strings
             codes, uniq = strings.dictionary_encode(table[ki])
@@ -94,8 +98,15 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
     order = order_by(table, list(key_indices))
     sorted_tbl = gather(table, order)
 
-    skeys = [sorted_tbl[ki].data for ki in key_indices]
-    svalid = [sorted_tbl[ki].validity for ki in key_indices]
+    skeys, svalid = [], []
+    for ki in key_indices:
+        col = sorted_tbl[ki]
+        if col.dtype.id == T.TypeId.DECIMAL128:   # compare both lanes
+            skeys += [col.data[:, 0], col.data[:, 1]]
+            svalid += [col.validity, col.validity]
+        else:
+            skeys.append(col.data)
+            svalid.append(col.validity)
     seg_ids = _segment_ids(skeys, svalid)
     num_segments = int(seg_ids[-1]) + 1   # scalar sync (group count)
 
@@ -170,3 +181,9 @@ def _empty_result(table: Table, key_indices, aggs) -> Table:
 def _take_rows(col: Column, idx: jnp.ndarray) -> Column:
     v = None if col.validity is None else col.validity[idx]
     return Column(col.dtype, col.data[idx], validity=v)
+
+
+def distinct(table: Table) -> Table:
+    """Distinct rows (Spark dropDuplicates over all columns) — a groupby on
+    every column with no aggregations; output order is the key sort order."""
+    return groupby_aggregate(table, list(range(table.num_columns)), [])

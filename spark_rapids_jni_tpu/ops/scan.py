@@ -1,0 +1,83 @@
+"""Cumulative (scan) operations — cudf scan / Spark running-aggregate analog.
+
+Null policy matches cudf's ``null_policy::EXCLUDE`` (what Spark's running
+aggregates need): null inputs contribute the identity to the running value
+and stay null in the output; valid rows see the accumulation over valid
+rows so far.  All scans are single XLA ops (``cumsum``/``cummax``/…) —
+associative-scan friendly on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..column import Column
+
+
+def _identity(kind: str, dtype, op: str):
+    if op == "sum":
+        return jnp.zeros((), dtype)
+    if op == "min":
+        return (jnp.asarray(jnp.inf, dtype) if kind == "f"
+                else jnp.asarray(np.iinfo(np.dtype(dtype)).max, dtype))
+    if op == "max":
+        return (jnp.asarray(-jnp.inf, dtype) if kind == "f"
+                else jnp.asarray(np.iinfo(np.dtype(dtype)).min, dtype))
+    raise ValueError(f"unknown scan op {op!r}")
+
+
+def _scan(col: Column, op: str) -> Column:
+    if (col.dtype.is_variable_width or col.dtype.is_nested
+            or col.dtype.id == T.TypeId.DECIMAL128):
+        raise TypeError(f"scan not supported on {col.dtype.id.name}")
+    data = col.data
+    out_dt = col.dtype
+    if op == "sum":
+        # accumulate in 64-bit like Spark's running sum; decimals keep
+        # their scale but widen to decimal64 (decimal32 would wrap)
+        if col.dtype.is_decimal:
+            out_dt = T.decimal64(col.dtype.scale)
+        else:
+            out_dt = T.float64 if col.dtype.storage.kind == "f" else T.int64
+        data = data.astype(out_dt.storage)
+    if col.validity is not None:
+        ident = _identity(col.dtype.storage.kind, data.dtype, op)
+        data = jnp.where(col.validity, data, ident)
+    if op == "sum":
+        res = jnp.cumsum(data)
+    elif op == "min":
+        res = jax_cummin(data)
+    else:
+        res = jax_cummax(data)
+    return Column(out_dt, res.astype(out_dt.storage), validity=col.validity)
+
+
+def jax_cummax(x: jnp.ndarray) -> jnp.ndarray:
+    import jax
+    return jax.lax.associative_scan(jnp.maximum, x)
+
+
+def jax_cummin(x: jnp.ndarray) -> jnp.ndarray:
+    import jax
+    return jax.lax.associative_scan(jnp.minimum, x)
+
+
+def cumulative_sum(col: Column) -> Column:
+    return _scan(col, "sum")
+
+
+def cumulative_min(col: Column) -> Column:
+    return _scan(col, "min")
+
+
+def cumulative_max(col: Column) -> Column:
+    return _scan(col, "max")
+
+
+def cumulative_count(col: Column) -> Column:
+    """Running count of VALID rows (Spark count over an expanding window)."""
+    ones = (col.validity.astype(jnp.int64) if col.validity is not None
+            else jnp.ones((col.num_rows,), jnp.int64))
+    return Column(T.int64, jnp.cumsum(ones))

@@ -8,14 +8,20 @@ A Pallas on-device bit-unpack is the planned optimization for the hot
 encodings; the host path is the correctness baseline and fallback.
 
 Supported (the TPC-H/TPC-DS working set, BASELINE configs #2-#4):
-* physical types BOOLEAN, INT32, INT64, FLOAT, DOUBLE, BYTE_ARRAY
-* encodings PLAIN, RLE, PLAIN_DICTIONARY / RLE_DICTIONARY
-* definition levels (RLE/bit-packed hybrid) for optional flat columns
+* physical types BOOLEAN, INT32, INT64, INT96 (legacy Impala timestamps),
+  FLOAT, DOUBLE, BYTE_ARRAY, FIXED_LEN_BYTE_ARRAY
+* converted types DECIMAL (int32/int64/FLBA → decimal32/64/128), DATE,
+  TIMESTAMP_MILLIS/MICROS, UTF8
+* encodings PLAIN, RLE, PLAIN_DICTIONARY / RLE_DICTIONARY,
+  DELTA_BINARY_PACKED, DELTA_LENGTH_BYTE_ARRAY, DELTA_BYTE_ARRAY
+* definition levels (RLE/bit-packed hybrid) for optional columns;
+  repetition levels for single-level LIST columns (max_rep == 1, both the
+  3-level LIST annotation and the legacy repeated-primitive form)
 * codecs UNCOMPRESSED, GZIP/zlib (stdlib), and SNAPPY (pure-Python decoder
   in ``parquet/snappy.py``; python-snappy accelerates it when present)
 * data page v1 and v2
 
-Nested columns (max repetition level > 0) are rejected for now.
+Deeper repetition (lists of lists, max_rep > 1) is rejected.
 """
 
 from __future__ import annotations
@@ -93,7 +99,19 @@ _PHYS_NP = {PT_INT32: np.dtype("<i4"), PT_INT64: np.dtype("<i8"),
             PT_FLOAT: np.dtype("<f4"), PT_DOUBLE: np.dtype("<f8")}
 _PHYS_DT = {PT_INT32: T.int32, PT_INT64: T.int64,
             PT_FLOAT: T.float32, PT_DOUBLE: T.float64,
-            PT_BOOLEAN: T.bool8, PT_BYTE_ARRAY: T.string}
+            PT_BOOLEAN: T.bool8, PT_BYTE_ARRAY: T.string,
+            PT_INT96: T.timestamp_ns,
+            PT_FIXED_LEN_BYTE_ARRAY: T.string}
+
+# ConvertedType enum values (public parquet.thrift)
+CT_UTF8, CT_MAP, CT_MAP_KEY_VALUE, CT_LIST, CT_ENUM, CT_DECIMAL, CT_DATE, \
+    CT_TIME_MILLIS, CT_TIME_MICROS, CT_TIMESTAMP_MILLIS, \
+    CT_TIMESTAMP_MICROS = range(11)
+
+# SchemaElement decimal metadata (parquet.thrift SchemaElement)
+SE_SCALE, SE_PRECISION = 7, 8
+
+_JULIAN_UNIX_EPOCH = 2440588   # Julian day number of 1970-01-01
 
 
 def _decompress(data: bytes, codec: int, uncompressed_size: int) -> bytes:
@@ -161,13 +179,133 @@ def decode_rle_bitpacked_hybrid(buf: bytes, bit_width: int,
     return out
 
 
-def _decode_plain(data: bytes, phys: int, n: int):
+def _uleb128(buf: bytes, pos: int) -> tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        b = buf[pos]; pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _zigzag(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def _unpack_bits_le(chunk: np.ndarray, n_vals: int, bw: int) -> np.ndarray:
+    """Little-endian bit-unpack: n_vals values of bw bits → int64."""
+    if bw == 0:
+        return np.zeros(n_vals, dtype=np.int64)
+    bits = np.unpackbits(chunk, bitorder="little")[:n_vals * bw]
+    weights = (1 << np.arange(bw, dtype=np.int64))
+    return (bits.reshape(n_vals, bw).astype(np.int64) * weights).sum(axis=1)
+
+
+def decode_delta_binary_packed(buf: bytes, pos: int = 0
+                               ) -> tuple[np.ndarray, int]:
+    """DELTA_BINARY_PACKED → (int64 values, end position).
+
+    Layout (parquet encodings spec): ULEB128 block_size, miniblocks/block,
+    total count, zigzag first value; then per block a zigzag min-delta,
+    one bitwidth byte per miniblock, and LE-bit-packed deltas.  Values are
+    first + prefix-sums of (min_delta + delta) — the cumsum is one
+    vectorized pass per miniblock.
+    """
+    block_size, pos = _uleb128(buf, pos)
+    n_mini, pos = _uleb128(buf, pos)
+    total, pos = _uleb128(buf, pos)
+    first_raw, pos = _uleb128(buf, pos)
+    first = _zigzag(first_raw)
+    vals_per_mini = block_size // n_mini
+    deltas = []
+    remaining = total - 1
+    while remaining > 0:
+        min_raw, pos = _uleb128(buf, pos)
+        min_delta = _zigzag(min_raw)
+        bws = np.frombuffer(buf, np.uint8, n_mini, pos)
+        pos += n_mini
+        for m in range(n_mini):
+            if remaining <= 0:
+                # trailing miniblock bytes of the last block still occupy
+                # the stream for non-zero bitwidths
+                pos += (int(bws[m]) * vals_per_mini) // 8
+                continue
+            bw = int(bws[m])
+            nbytes = (bw * vals_per_mini) // 8
+            chunk = np.frombuffer(buf, np.uint8, nbytes, pos)
+            pos += nbytes
+            d = _unpack_bits_le(chunk, vals_per_mini, bw) + min_delta
+            take = min(vals_per_mini, remaining)
+            deltas.append(d[:take])
+            remaining -= take
+    if deltas:
+        all_d = np.concatenate(deltas)
+        out = np.empty(total, dtype=np.int64)
+        out[0] = first
+        np.cumsum(all_d, out=out[1:])
+        out[1:] += first
+    else:
+        out = np.full(max(total, 0), first, dtype=np.int64)
+    return out, pos
+
+
+def _decode_delta_length_byte_array(data: bytes, n: int):
+    lengths, pos = decode_delta_binary_packed(data)
+    chars = np.frombuffer(data, np.uint8, int(lengths.sum()), pos)
+    return chars.copy(), lengths.astype(np.int32)
+
+
+def _decode_delta_byte_array(data: bytes, n: int):
+    """DELTA_BYTE_ARRAY: shared-prefix lengths + suffix stream.
+
+    Reconstruction is inherently sequential (each value references the
+    previous one) — host loop, matching the spec's reference decoding.
+    """
+    prefix_lens, pos = decode_delta_binary_packed(data)
+    suffix_lens, pos = decode_delta_binary_packed(data, pos)
+    suffix = np.frombuffer(data, np.uint8, int(suffix_lens.sum()), pos)
+    out_lens = (prefix_lens + suffix_lens).astype(np.int32)
+    chars = np.empty(int(out_lens.sum()), dtype=np.uint8)
+    prev_start = 0
+    spos = cursor = 0
+    for i in range(len(out_lens)):
+        pl, sl = int(prefix_lens[i]), int(suffix_lens[i])
+        start = cursor
+        chars[cursor:cursor + pl] = chars[prev_start:prev_start + pl]
+        cursor += pl
+        chars[cursor:cursor + sl] = suffix[spos:spos + sl]
+        cursor += sl
+        spos += sl
+        prev_start = start
+    return chars, out_lens
+
+
+def _decode_int96(data: bytes, n: int) -> np.ndarray:
+    """INT96 legacy timestamps → int64 nanoseconds since the Unix epoch.
+
+    Each value is 8 LE bytes of nanos-within-day + 4 LE bytes Julian day
+    (the Impala convention the reference's Spark plugin must also honor).
+    """
+    raw = np.frombuffer(data, np.uint8, n * 12).reshape(n, 12)
+    nanos = raw[:, :8].copy().view("<u8").reshape(n).astype(np.int64)
+    days = raw[:, 8:].copy().view("<i4").reshape(n).astype(np.int64)
+    return (days - _JULIAN_UNIX_EPOCH) * 86_400_000_000_000 + nanos
+
+
+def _decode_plain(data: bytes, phys: int, n: int, type_len: int = 0):
     """PLAIN-encoded values → (values ndarray or (chars, lengths) for strings)."""
     if phys in _PHYS_NP:
         return np.frombuffer(data, dtype=_PHYS_NP[phys], count=n)
     if phys == PT_BOOLEAN:
         return np.unpackbits(np.frombuffer(data, dtype=np.uint8),
                              count=n, bitorder="little").astype(np.uint8)
+    if phys == PT_INT96:
+        return _decode_int96(data, n)
+    if phys == PT_FIXED_LEN_BYTE_ARRAY:
+        chars = np.frombuffer(data, np.uint8, n * type_len).copy()
+        return chars, np.full(n, type_len, dtype=np.int32)
     if phys == PT_BYTE_ARRAY:
         # length-prefixed strings — vectorized walk of the length prefixes
         lengths = np.empty(n, dtype=np.int32)
@@ -209,8 +347,17 @@ class _PageStream:
         return header, raw
 
 
-def _decode_chunk(file_bytes: bytes, chunk: Struct, max_def: int):
-    """Decode one flat column chunk → (values, lengths_or_none, valid_or_none)."""
+_VARLEN_PHYS = (PT_BYTE_ARRAY, PT_FIXED_LEN_BYTE_ARRAY)
+
+
+def _decode_chunk(file_bytes: bytes, chunk: Struct, max_def: int,
+                  max_rep: int = 0, type_len: int = 0):
+    """Decode one column chunk → (values, lengths_or_none, defs, reps).
+
+    ``values``/``lengths`` cover only the PRESENT slots (def == max_def);
+    ``defs``/``reps`` are per-slot level arrays (None when the schema has
+    none) — callers assemble validity / list structure from them.
+    """
     md = chunk.get(CC.META_DATA)
     phys = md.get(CMD.TYPE)
     codec = md.get(CMD.CODEC, 0)
@@ -223,7 +370,7 @@ def _decode_chunk(file_bytes: bytes, chunk: Struct, max_def: int):
     stream = _PageStream(file_bytes[start:start + total], codec)
 
     dictionary = None
-    vals_parts, len_parts, def_parts = [], [], []
+    vals_parts, len_parts, def_parts, rep_parts = [], [], [], []
     decoded = 0
     while decoded < num_values:
         header, raw = stream.next_page()
@@ -232,7 +379,8 @@ def _decode_chunk(file_bytes: bytes, chunk: Struct, max_def: int):
         if ptype == PAGE_DICTIONARY:
             dph = header.get(PH.DICT_PAGE)
             data = _decompress(raw, codec, usize)
-            dictionary = _decode_plain(data, phys, dph.get(DPH.NUM_VALUES))
+            dictionary = _decode_plain(data, phys, dph.get(DPH.NUM_VALUES),
+                                       type_len)
             continue
         if ptype == PAGE_DATA:
             dph = header.get(PH.DATA_PAGE)
@@ -240,7 +388,13 @@ def _decode_chunk(file_bytes: bytes, chunk: Struct, max_def: int):
             enc = dph.get(DPH.ENCODING)
             data = _decompress(raw, codec, usize)
             pos = 0
-            defs = None
+            defs = reps = None
+            if max_rep > 0:   # repetition levels precede definition levels
+                (ln,) = _struct.unpack_from("<I", data, pos)
+                pos += 4
+                reps = decode_rle_bitpacked_hybrid(
+                    data[pos:pos + ln], _bit_width(max_rep), n)
+                pos += ln
             if max_def > 0:
                 (ln,) = _struct.unpack_from("<I", data, pos)
                 pos += 4
@@ -254,30 +408,30 @@ def _decode_chunk(file_bytes: bytes, chunk: Struct, max_def: int):
             enc = dph.get(DPH2.ENCODING)
             dl_len = dph.get(DPH2.DEF_LEVELS_BYTE_LENGTH, 0)
             rl_len = dph.get(DPH2.REP_LEVELS_BYTE_LENGTH, 0)
-            if rl_len:
-                raise NotImplementedError("nested (repeated) columns")
-            defs = None
-            levels = raw[:dl_len + rl_len]
+            defs = reps = None
             body = raw[dl_len + rl_len:]
             if dph.get(DPH2.IS_COMPRESSED, True):
                 body = _decompress(
                     body, codec, usize - dl_len - rl_len)
+            if max_rep > 0 and rl_len:
+                reps = decode_rle_bitpacked_hybrid(
+                    raw[:rl_len], _bit_width(max_rep), n)
             if max_def > 0 and dl_len:
                 defs = decode_rle_bitpacked_hybrid(
-                    levels, _bit_width(max_def), n)
+                    raw[rl_len:rl_len + dl_len], _bit_width(max_def), n)
             page_vals = body
         else:
             continue  # index pages etc.
 
         n_present = n if defs is None else int((defs == max_def).sum())
         if enc == ENC_PLAIN:
-            vals = _decode_plain(page_vals, phys, n_present)
+            vals = _decode_plain(page_vals, phys, n_present, type_len)
         elif enc in (ENC_PLAIN_DICTIONARY, ENC_RLE_DICTIONARY):
             if dictionary is None:
                 raise ValueError("dictionary-encoded page before dictionary")
             bw = page_vals[0]
             idx = decode_rle_bitpacked_hybrid(page_vals[1:], bw, n_present)
-            if phys == PT_BYTE_ARRAY:
+            if phys in _VARLEN_PHYS:
                 dchars, dlens = dictionary
                 dstarts = np.zeros(len(dlens) + 1, dtype=np.int64)
                 np.cumsum(dlens, out=dstarts[1:])
@@ -292,71 +446,274 @@ def _decode_chunk(file_bytes: bytes, chunk: Struct, max_def: int):
                 vals = (chars, lens)
             else:
                 vals = dictionary[idx]
+        elif enc == ENC_DELTA_BINARY_PACKED and phys in (PT_INT32, PT_INT64):
+            decoded_vals, _ = decode_delta_binary_packed(page_vals)
+            vals = decoded_vals[:n_present].astype(_PHYS_NP[phys])
+        elif enc == ENC_DELTA_LENGTH_BYTE_ARRAY and phys == PT_BYTE_ARRAY:
+            vals = _decode_delta_length_byte_array(page_vals, n_present)
+        elif enc == ENC_DELTA_BYTE_ARRAY and phys in _VARLEN_PHYS:
+            vals = _decode_delta_byte_array(page_vals, n_present)
         else:
             raise NotImplementedError(f"unsupported encoding {enc}")
 
-        if phys == PT_BYTE_ARRAY:
+        if phys in _VARLEN_PHYS:
             vals_parts.append(vals[0])
             len_parts.append(vals[1])
         else:
             vals_parts.append(vals)
         if defs is not None:
             def_parts.append(defs)
+        if reps is not None:
+            rep_parts.append(reps)
         decoded += n
 
-    valid = None
-    if def_parts:
-        defs_all = np.concatenate(def_parts)
-        valid = defs_all == max_def
-    if phys == PT_BYTE_ARRAY:
+    defs_all = np.concatenate(def_parts) if def_parts else None
+    reps_all = np.concatenate(rep_parts) if rep_parts else None
+    if phys in _VARLEN_PHYS:
         chars = (np.concatenate(vals_parts) if vals_parts
                  else np.zeros(0, np.uint8))
         lens = (np.concatenate(len_parts) if len_parts
                 else np.zeros(0, np.int32))
-        return chars, lens, valid
+        return chars, lens, defs_all, reps_all
     values = (np.concatenate(vals_parts) if vals_parts
               else np.zeros(0, np.int32))
-    return values, None, valid
+    return values, None, defs_all, reps_all
 
 
-def _leaf_schema_elements(meta: Struct):
-    """Flat walk of the schema: leaves with (element, max_def_level, path)."""
+class _Leaf:
+    """One leaf column's schema facts, gathered by the depth-first walk."""
+
+    def __init__(self, elem, max_def, max_rep, d_list, path):
+        self.elem = elem
+        self.max_def = max_def          # def level meaning "value present"
+        self.max_rep = max_rep          # 0 = flat, 1 = single-level list
+        self.d_list = d_list            # def level at the repeated node
+        self.path = path
+        # user-facing column name: struct leaves keep their full dotted
+        # path (each leaf is a distinct output column); LIST leaves take
+        # the outer field name (the chunk path is "name.list.element")
+        self.name = path.split(".")[0] if max_rep > 0 else path
+
+    @property
+    def phys(self):
+        return self.elem.get(SE.TYPE)
+
+    @property
+    def type_len(self):
+        return self.elem.get(SE.TYPE_LENGTH, 0) or 0
+
+    def logical_dtype(self) -> T.DType:
+        """Element-level logical dtype from physical + converted type."""
+        phys = self.phys
+        ct = self.elem.get(SE.CONVERTED_TYPE)
+        if ct == CT_DECIMAL:
+            scale = -(self.elem.get(SE_SCALE, 0) or 0)
+            precision = self.elem.get(SE_PRECISION, 0) or 0
+            if phys == PT_INT32:
+                return T.decimal32(scale)
+            if phys == PT_INT64:
+                return T.decimal64(scale)
+            if phys in _VARLEN_PHYS:
+                if precision and precision <= 9:
+                    return T.decimal32(scale)
+                if precision and precision <= 18:
+                    return T.decimal64(scale)
+                return T.decimal128(scale)
+            raise NotImplementedError(f"DECIMAL on physical type {phys}")
+        if ct == CT_DATE and phys == PT_INT32:
+            return T.timestamp_days
+        if ct == CT_TIMESTAMP_MILLIS and phys == PT_INT64:
+            return T.timestamp_ms
+        if ct == CT_TIMESTAMP_MICROS and phys == PT_INT64:
+            return T.timestamp_us
+        return _PHYS_DT[phys]
+
+
+def _leaf_schema_elements(meta: Struct) -> list[_Leaf]:
+    """Depth-first walk: leaves with def/rep depths (Dremel levels)."""
     schema = meta.get(FMD.SCHEMA).values
-    out = []
-    # index 0 is the root
-    def walk(idx: int, depth_def: int, prefix: str):
+    out: list[_Leaf] = []
+
+    def walk(idx: int, depth_def: int, depth_rep: int, d_list: int,
+             prefix: str):
         elem = schema[idx]
         n = elem.get(SE.NUM_CHILDREN, 0) or 0
         name = elem.get(SE.NAME, b"").decode("utf-8")
         rep = elem.get(SE.REPETITION_TYPE, 0)
-        # optional (1) adds a definition level; repeated (2) unsupported here
-        my_def = depth_def + (1 if rep == 1 else 0)
-        if rep == 2:
-            raise NotImplementedError("nested (repeated) columns")
+        # optional (1) adds a definition level; repeated (2) adds both a
+        # definition and a repetition level
+        my_def = depth_def + (1 if rep in (1, 2) else 0)
+        my_rep = depth_rep + (1 if rep == 2 else 0)
+        my_dlist = my_def if rep == 2 else d_list
+        if my_rep > 1:
+            raise NotImplementedError("nested lists (max_rep > 1)")
         path = f"{prefix}.{name}" if prefix else name
         idx += 1
         if n == 0:
-            out.append((elem, my_def, path))
+            out.append(_Leaf(elem, my_def, my_rep, my_dlist, path))
             return idx
         for _ in range(n):
-            idx = walk(idx, my_def, path)
+            idx = walk(idx, my_def, my_rep, my_dlist, path)
         return idx
 
     idx = 1
     root_children = schema[0].get(SE.NUM_CHILDREN, 0) or 0
     for _ in range(root_children):
-        idx = walk(idx, 0, "")
+        idx = walk(idx, 0, 0, 0, "")
     return out
+
+
+def _be_varlen_decimal_to_lanes(chars: np.ndarray,
+                                lens: np.ndarray) -> np.ndarray:
+    """Variable-length BYTE_ARRAY decimals (parquet-mr/Hive legacy writers)
+    → [n, 2] int64 lane pairs.  Per-value host loop — cold legacy path."""
+    n = lens.shape[0]
+    lanes = np.zeros((n, 2), dtype=np.int64)
+    raw = chars.tobytes()
+    pos = 0
+    for i in range(n):
+        ln = int(lens[i])
+        v = int.from_bytes(raw[pos:pos + ln], "big", signed=True) if ln else 0
+        pos += ln
+        u = v & ((1 << 128) - 1)
+        lo = u & ((1 << 64) - 1)
+        hi = u >> 64
+        lanes[i, 0] = np.int64(lo - (1 << 64) if lo >= (1 << 63) else lo)
+        lanes[i, 1] = np.int64(hi - (1 << 64) if hi >= (1 << 63) else hi)
+    return lanes
+
+
+def _be_decimal_to_lanes(chars: np.ndarray, width: int) -> np.ndarray:
+    """Big-endian two's-complement FLBA decimals → [n, 2] int64 lane pairs."""
+    n = chars.shape[0] // width if width else 0
+    b = chars.reshape(n, width)
+    sign = b[:, 0] >= 0x80
+    full = np.empty((n, 16), dtype=np.uint8)
+    full[:, :16 - width] = np.where(sign, 0xFF, 0)[:, None]
+    full[:, 16 - width:] = b
+    hi = full[:, :8].copy().view(">i8").reshape(n).astype(np.int64)
+    # read big-endian VALUE first (astype converts), then reinterpret the
+    # native bits as int64 — a direct .view on the BE array would byteswap
+    lo = (full[:, 8:].copy().view(">u8").reshape(n)
+          .astype(np.uint64).view(np.int64))
+    return np.stack([lo, hi], axis=1)
+
+
+def _present_leaf_column(leaf: _Leaf, values, lens, valid) -> Column:
+    """Build the element-level Column from present-slot arrays + validity."""
+    dt = leaf.logical_dtype()
+    phys = leaf.phys
+    jvalid = None if valid is None else jnp.asarray(valid)
+    nrows = valid.shape[0] if valid is not None else _n_present(leaf, values,
+                                                               lens)
+    if phys in _VARLEN_PHYS and dt.is_decimal:
+        width = leaf.type_len
+        if phys == PT_BYTE_ARRAY or not width:
+            lanes = _be_varlen_decimal_to_lanes(values, lens)
+        else:
+            lanes = _be_decimal_to_lanes(values, width)
+        if valid is not None:
+            expanded = np.zeros((nrows, 2), dtype=np.int64)
+            expanded[valid] = lanes
+            lanes = expanded
+        if dt.id == T.TypeId.DECIMAL128:
+            return Column(dt, jnp.asarray(lanes), validity=jvalid)
+        narrow = lanes[:, 0].astype(dt.storage)
+        return Column(dt, jnp.asarray(narrow), validity=jvalid)
+    if phys in _VARLEN_PHYS:
+        # strings (incl. fixed-len binary): re-expand lengths over nulls
+        if valid is not None:
+            full_lens = np.zeros(nrows, dtype=np.int64)
+            full_lens[valid] = lens
+        else:
+            full_lens = lens.astype(np.int64)
+        offs = np.zeros(full_lens.shape[0] + 1, dtype=np.int32)
+        np.cumsum(full_lens, out=offs[1:])
+        return Column(T.string if not dt.is_decimal else dt,
+                      jnp.asarray(values), jnp.asarray(offs), jvalid)
+    if valid is not None:
+        full = np.zeros(nrows, dtype=values.dtype)
+        full[valid] = values
+        values = full
+    return Column(dt, jnp.asarray(np.ascontiguousarray(values,
+                                                       dtype=dt.storage)),
+                  validity=jvalid)
+
+
+def _n_present(leaf, values, lens):
+    return lens.shape[0] if leaf.phys in _VARLEN_PHYS else values.shape[0]
+
+
+def _concat_parts(leaf: _Leaf, parts):
+    """(values, lens_or_none) concatenated across row-group parts."""
+    values = np.concatenate([p[0] for p in parts])
+    lens = (np.concatenate([p[1] for p in parts])
+            if leaf.phys in _VARLEN_PHYS else None)
+    return values, lens
+
+
+def _assemble_flat(leaf: _Leaf, parts) -> Column:
+    """Concatenate row-group parts of a flat column into one Column."""
+    defs = None
+    if any(p[2] is not None for p in parts):
+        defs = np.concatenate(
+            [p[2] if p[2] is not None
+             else np.full(_n_present(leaf, p[0], p[1]), leaf.max_def,
+                          dtype=np.uint32) for p in parts])
+    valid = None if defs is None else defs == leaf.max_def
+    values, lens = _concat_parts(leaf, parts)
+    return _present_leaf_column(leaf, values, lens, valid)
+
+
+def _assemble_list(leaf: _Leaf, parts) -> Column:
+    """Dremel assembly of a single-level LIST column.
+
+    Per slot: rep == 0 starts a new row.  def >= d_list ⇒ the slot is an
+    element (null element unless def == max_def); def == d_list-1 ⇒ empty
+    list; def < d_list-1 ⇒ the list itself is null at some ancestor.
+    """
+    defs = np.concatenate([p[2] for p in parts])
+    reps = np.concatenate([p[3] if p[3] is not None
+                           else np.zeros(p[2].shape[0], np.uint32)
+                           for p in parts])
+    is_elem = defs >= leaf.d_list
+    row_start = reps == 0
+    nrows = int(row_start.sum())
+    # list lengths: count element slots per row
+    row_id = np.cumsum(row_start) - 1
+    lengths = np.zeros(nrows, dtype=np.int64)
+    np.add.at(lengths, row_id[is_elem], 1)
+    offsets = np.zeros(nrows + 1, dtype=np.int32)
+    np.cumsum(lengths, out=offsets[1:])
+    # list validity from each row's first slot
+    first_defs = defs[row_start]
+    list_valid = first_defs >= leaf.d_list - 1
+    jlist_valid = None if list_valid.all() else jnp.asarray(list_valid)
+    # element column from element slots
+    elem_valid = defs[is_elem] == leaf.max_def
+    if leaf.max_def == leaf.d_list:      # required elements: all valid
+        evalid = None
+    else:
+        evalid = elem_valid
+    values, lens = _concat_parts(leaf, parts)
+    child = _present_leaf_column(leaf, values, lens, evalid)
+    dtype = T.list_(child.dtype)
+    return Column(dtype, jnp.zeros((0,), jnp.uint8), jnp.asarray(offsets),
+                  jlist_valid, [child])
 
 
 @fault_site("parquet_read_table")
 def read_table(file_bytes: bytes,
                columns: Optional[list[str]] = None) -> Table:
-    """Read a (flat-schema) parquet file into a device Table."""
+    """Read a parquet file into a device Table.
+
+    ``columns`` selects by user-facing column name (for LIST columns, the
+    outer field name — the underlying chunk path is ``name.list.element``).
+    """
     from .thrift import parse_struct
     meta = parse_struct(extract_footer_bytes(file_bytes))
     leaves = _leaf_schema_elements(meta)
-    names = [path for (_, _, path) in leaves]
+    names = [leaf.name for leaf in leaves]
     want = list(range(len(leaves))) if columns is None else [
         names.index(c) for c in columns]
 
@@ -365,48 +722,17 @@ def read_table(file_bytes: bytes,
     for rg in groups.values:
         chunks = rg.get(RG.COLUMNS).values
         for i in want:
-            elem, max_def, _ = leaves[i]
+            leaf = leaves[i]
             per_col_parts[i].append(
-                _decode_chunk(file_bytes, chunks[i], max_def))
+                _decode_chunk(file_bytes, chunks[i], leaf.max_def,
+                              leaf.max_rep, leaf.type_len))
 
     cols = []
     for i in want:
-        elem, max_def, _ = leaves[i]
-        phys = elem.get(SE.TYPE)
-        dt = _PHYS_DT[phys]
+        leaf = leaves[i]
         parts = per_col_parts[i]
-        valid = None
-        if any(p[2] is not None for p in parts):
-            valid = np.concatenate(
-                [p[2] if p[2] is not None
-                 else np.ones(_part_rows(p, phys), dtype=bool) for p in parts])
-        if phys == PT_BYTE_ARRAY:
-            chars = np.concatenate([p[0] for p in parts])
-            lens_present = np.concatenate([p[1] for p in parts])
-            # re-expand lengths over nulls (null rows have no stored value)
-            if valid is not None:
-                lens = np.zeros(valid.shape[0], dtype=np.int64)
-                lens[valid] = lens_present
-            else:
-                lens = lens_present.astype(np.int64)
-            offs = np.zeros(lens.shape[0] + 1, dtype=np.int32)
-            np.cumsum(lens, out=offs[1:])
-            cols.append(Column(dt, jnp.asarray(chars), jnp.asarray(offs),
-                               None if valid is None else jnp.asarray(valid)))
+        if leaf.max_rep > 0:
+            cols.append(_assemble_list(leaf, parts))
         else:
-            vals_present = np.concatenate([p[0] for p in parts])
-            if valid is not None:
-                vals = np.zeros(valid.shape[0], dtype=vals_present.dtype)
-                vals[valid] = vals_present
-            else:
-                vals = vals_present
-            cols.append(Column(dt, jnp.asarray(
-                np.ascontiguousarray(vals, dtype=dt.storage)),
-                validity=None if valid is None else jnp.asarray(valid)))
+            cols.append(_assemble_flat(leaf, parts))
     return Table(cols)
-
-
-def _part_rows(part, phys):
-    if phys == PT_BYTE_ARRAY:
-        return part[1].shape[0]
-    return part[0].shape[0]

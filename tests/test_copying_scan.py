@@ -1,0 +1,161 @@
+"""concat/slice/scan/distinct — differential vs pandas/numpy oracles.
+
+These fill the libcudf op-breadth gap (SURVEY §2.9: cudf::concatenate,
+cudf::slice, scan, drop_duplicates) flagged in VERDICT round 1.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_jni_tpu import types as T
+from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu.ops import (concat_tables, cumulative_count,
+                                      cumulative_max, cumulative_min,
+                                      cumulative_sum, distinct, slice_table)
+
+
+def _mixed(n, seed):
+    rng = np.random.default_rng(seed)
+    ints = Column.from_numpy(rng.integers(-100, 100, n).astype(np.int32),
+                             validity=rng.random(n) < 0.9)
+    strs = Column.strings_from_list(
+        [None if rng.random() < 0.1 else f"s{rng.integers(0, 50)}"
+         for _ in range(n)])
+    lists = Column.list_from_pylist(
+        [None if rng.random() < 0.1 else
+         list(rng.integers(0, 10, rng.integers(0, 4)).tolist())
+         for _ in range(n)])
+    return Table([ints, strs, lists])
+
+
+class TestConcat:
+    def test_concat_mixed(self):
+        a, b, c = _mixed(17, 0), _mixed(5, 1), _mixed(31, 2)
+        out = concat_tables([a, b, c])
+        assert out.num_rows == 53
+        for i in range(3):
+            want = a[i].to_pylist() + b[i].to_pylist() + c[i].to_pylist()
+            assert out[i].to_pylist() == want
+
+    def test_concat_single(self):
+        a = _mixed(4, 3)
+        out = concat_tables([a])
+        assert out[1].to_pylist() == a[1].to_pylist()
+
+    def test_dtype_mismatch_rejected(self):
+        a = Table([Column.from_numpy(np.zeros(2, np.int32))])
+        b = Table([Column.from_numpy(np.zeros(2, np.int64))])
+        with pytest.raises(TypeError):
+            concat_tables([a, b])
+
+
+class TestSlice:
+    def test_slice_mixed(self):
+        t = _mixed(40, 4)
+        out = slice_table(t, 7, 11)
+        assert out.num_rows == 11
+        for i in range(3):
+            assert out[i].to_pylist() == t[i].to_pylist()[7:18]
+
+    def test_slice_bounds_clamped(self):
+        t = _mixed(10, 5)
+        assert slice_table(t, 8, 100).num_rows == 2
+        assert slice_table(t, 100, 5).num_rows == 0
+        assert slice_table(t, 0).num_rows == 10
+
+    def test_slice_then_concat_roundtrip(self):
+        t = _mixed(23, 6)
+        parts = [slice_table(t, 0, 9), slice_table(t, 9, 9),
+                 slice_table(t, 18, 9)]
+        out = concat_tables(parts)
+        for i in range(3):
+            assert out[i].to_pylist() == t[i].to_pylist()
+
+
+class TestScan:
+    def test_cumsum_matches_pandas(self):
+        rng = np.random.default_rng(7)
+        vals = rng.integers(-50, 50, 100).astype(np.int32)
+        valid = rng.random(100) < 0.8
+        col = Column.from_numpy(vals, validity=valid)
+        got = cumulative_sum(col)
+        s = pd.Series(np.where(valid, vals, np.nan))
+        want = s.fillna(0).cumsum()
+        got_vals = np.asarray(got.data)
+        np.testing.assert_array_equal(got_vals, want.to_numpy().astype(np.int64))
+        # null positions stay null (cudf EXCLUDE policy)
+        assert got.to_pylist() == [int(w) if v else None
+                                   for w, v in zip(want, valid)]
+
+    def test_cumsum_float(self):
+        vals = np.asarray([1.5, 2.5, -1.0], np.float32)
+        got = cumulative_sum(Column.from_numpy(vals))
+        assert got.dtype == T.float64
+        np.testing.assert_allclose(np.asarray(got.data), [1.5, 4.0, 3.0])
+
+    def test_cummin_cummax(self):
+        rng = np.random.default_rng(8)
+        vals = rng.integers(-50, 50, 64).astype(np.int64)
+        valid = rng.random(64) < 0.7
+        col = Column.from_numpy(vals, validity=valid)
+        s = pd.Series(np.where(valid, vals.astype(float), np.nan))
+        np.testing.assert_array_equal(
+            np.asarray(cumulative_max(col).data)[valid],
+            s.cummax().to_numpy()[valid].astype(np.int64))
+        np.testing.assert_array_equal(
+            np.asarray(cumulative_min(col).data)[valid],
+            s.cummin().to_numpy()[valid].astype(np.int64))
+
+    def test_cumcount(self):
+        col = Column.from_numpy(np.arange(5, dtype=np.int32),
+                                validity=np.asarray([1, 0, 1, 1, 0], bool))
+        assert np.asarray(cumulative_count(col).data).tolist() == [1, 1, 2, 3, 3]
+
+    def test_scan_rejects_strings(self):
+        with pytest.raises(TypeError):
+            cumulative_sum(Column.strings_from_list(["a"]))
+
+
+class TestDistinct:
+    def test_distinct_matches_pandas(self):
+        rng = np.random.default_rng(9)
+        a = rng.integers(0, 4, 60).astype(np.int32)
+        b = [f"k{v}" for v in rng.integers(0, 3, 60)]
+        t = Table([Column.from_numpy(a), Column.strings_from_list(b)])
+        out = distinct(t)
+        got = set(zip(out[0].to_pylist(), out[1].to_pylist()))
+        want = set(pd.DataFrame({"a": a, "b": b})
+                   .drop_duplicates().itertuples(index=False, name=None))
+        assert got == want
+
+    def test_distinct_empty(self):
+        t = Table([Column.from_numpy(np.zeros(0, np.int32))])
+        assert distinct(t).num_rows == 0
+
+
+class TestReviewRegressions:
+    def test_distinct_decimal128_column(self):
+        from spark_rapids_jni_tpu.ops import decimal128 as d128
+        col = d128.from_pyints([2**100, 5, 2**100, 5, 7])
+        out = distinct(Table([col]))
+        assert sorted(out[0].to_pylist()) == [5, 7, 2**100]
+
+    def test_distinct_list_column_rejected(self):
+        col = Column.list_from_pylist([[1], [1]])
+        with pytest.raises(NotImplementedError):
+            distinct(Table([col]))
+
+    def test_cumsum_decimal32_widens(self):
+        # running total exceeds int32: must widen to decimal64, not wrap
+        vals = np.full(1100, 2_000_000_000 // 1000, np.int32) * 1000
+        col = Column.from_numpy(vals, T.decimal32(-2))
+        out = cumulative_sum(col)
+        assert out.dtype == T.decimal64(-2)
+        assert int(np.asarray(out.data)[-1]) == int(vals.astype(np.int64).sum())
+
+    def test_cumsum_decimal128_rejected(self):
+        from spark_rapids_jni_tpu.ops import decimal128 as d128
+        with pytest.raises(TypeError):
+            cumulative_sum(d128.from_pyints([1]))

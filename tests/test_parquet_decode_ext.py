@@ -1,0 +1,244 @@
+"""Extended parquet decode coverage: INT96, FLBA decimals, DELTA encodings,
+and single-level LIST columns — differential vs pyarrow-written files.
+
+Closes VERDICT round-1 item 6 (decode.py:105,191,258,296,335 gaps).
+"""
+
+import decimal
+import io
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_jni_tpu import types as T
+from spark_rapids_jni_tpu.parquet.decode import (decode_delta_binary_packed,
+                                                 read_table)
+
+
+def write(table: pa.Table, **kw) -> bytes:
+    buf = io.BytesIO()
+    pq.write_table(table, buf, **kw)
+    return buf.getvalue()
+
+
+class TestDeltaBinaryPacked:
+    def test_int64_roundtrip(self):
+        rng = np.random.default_rng(0)
+        vals = rng.integers(-10**12, 10**12, 5000)
+        data = write(pa.table({"a": pa.array(vals, pa.int64())}),
+                     use_dictionary=False,
+                     column_encoding={"a": "DELTA_BINARY_PACKED"})
+        t = read_table(data)
+        np.testing.assert_array_equal(np.asarray(t[0].data), vals)
+
+    def test_int32_monotonic(self):
+        vals = np.arange(10000, dtype=np.int32) * 3 - 5000
+        data = write(pa.table({"a": pa.array(vals, pa.int32())}),
+                     use_dictionary=False,
+                     column_encoding={"a": "DELTA_BINARY_PACKED"})
+        t = read_table(data)
+        assert t[0].dtype == T.int32
+        np.testing.assert_array_equal(np.asarray(t[0].data), vals)
+
+    def test_with_nulls(self):
+        vals = [1, None, 3, None, -7] * 100
+        data = write(pa.table({"a": pa.array(vals, pa.int64())}),
+                     use_dictionary=False,
+                     column_encoding={"a": "DELTA_BINARY_PACKED"})
+        t = read_table(data)
+        assert t[0].to_pylist() == vals
+
+    def test_decoder_unit_tiny(self):
+        # single value → no delta blocks at all
+        data = write(pa.table({"a": pa.array([42], pa.int64())}),
+                     use_dictionary=False,
+                     column_encoding={"a": "DELTA_BINARY_PACKED"})
+        assert read_table(data)[0].to_pylist() == [42]
+
+
+class TestDeltaByteArray:
+    def test_delta_length_byte_array(self):
+        strs = [f"value_{i:05d}" for i in range(2000)] + ["", "x"]
+        data = write(pa.table({"s": pa.array(strs)}), use_dictionary=False,
+                     column_encoding={"s": "DELTA_LENGTH_BYTE_ARRAY"})
+        assert read_table(data)[0].to_pylist() == strs
+
+    def test_delta_byte_array_shared_prefixes(self):
+        strs = sorted(f"prefix_{i % 7}_suffix_{i:04d}" for i in range(3000))
+        data = write(pa.table({"s": pa.array(strs)}), use_dictionary=False,
+                     column_encoding={"s": "DELTA_BYTE_ARRAY"})
+        assert read_table(data)[0].to_pylist() == strs
+
+    def test_delta_byte_array_nulls(self):
+        strs = ["aa", None, "ab", "abc", None, "b"] * 50
+        data = write(pa.table({"s": pa.array(strs)}), use_dictionary=False,
+                     column_encoding={"s": "DELTA_BYTE_ARRAY"})
+        assert read_table(data)[0].to_pylist() == strs
+
+
+class TestInt96:
+    def test_int96_timestamps(self):
+        ts = pd.to_datetime(["1970-01-01 00:00:00",
+                             "2020-02-29 23:59:59.123456",
+                             "1969-12-31 12:00:00",
+                             "2038-01-19 03:14:07"], format="mixed")
+        data = write(pa.table({"ts": pa.array(ts)}),
+                     use_deprecated_int96_timestamps=True)
+        t = read_table(data)
+        assert t[0].dtype == T.timestamp_ns
+        want = ts.astype("datetime64[ns]").astype(np.int64)
+        np.testing.assert_array_equal(np.asarray(t[0].data), want)
+
+
+class TestDecimals:
+    def test_flba_decimal128(self):
+        vals = [decimal.Decimal("12345678901234567890.12"),
+                decimal.Decimal("-0.01"), None,
+                decimal.Decimal("99999999999999999999999999.99")]
+        data = write(pa.table({"d": pa.array(vals, pa.decimal128(28, 2))}))
+        t = read_table(data)
+        assert t[0].dtype == T.decimal128(-2)
+        want = [None if v is None else int(v.scaleb(2)) for v in vals]
+        assert t[0].to_pylist() == want
+
+    def test_flba_decimal64(self):
+        vals = [decimal.Decimal("123456.789"), decimal.Decimal("-42.001"),
+                None]
+        data = write(pa.table({"d": pa.array(vals, pa.decimal128(15, 3))}))
+        t = read_table(data)
+        assert t[0].dtype == T.decimal64(-3)
+        want = [None if v is None else int(v.scaleb(3)) for v in vals]
+        assert t[0].to_pylist() == want
+
+    def test_flba_decimal32(self):
+        vals = [decimal.Decimal("1.23"), decimal.Decimal("-9.99")]
+        data = write(pa.table({"d": pa.array(vals, pa.decimal128(7, 2))}))
+        t = read_table(data)
+        assert t[0].dtype == T.decimal32(-2)
+        assert t[0].to_pylist() == [123, -999]
+
+    def test_int32_int64_decimal(self):
+        # pyarrow writes small decimals as int32/int64 when asked
+        import pyarrow.parquet as _pq
+        buf = io.BytesIO()
+        tbl = pa.table({"d4": pa.array([decimal.Decimal("1.5")],
+                                       pa.decimal128(4, 1)),
+                        "d12": pa.array([decimal.Decimal("123.456")],
+                                        pa.decimal128(12, 3))})
+        _pq.write_table(tbl, buf, store_decimal_as_integer=True)
+        t = read_table(buf.getvalue())
+        assert t[0].dtype == T.decimal32(-1) and t[0].to_pylist() == [15]
+        assert t[1].dtype == T.decimal64(-3) and t[1].to_pylist() == [123456]
+
+
+class TestConvertedTypes:
+    def test_date32(self):
+        dates = pa.array([0, 365, -1, 19000], pa.date32())
+        t = read_table(write(pa.table({"d": dates})))
+        assert t[0].dtype == T.timestamp_days
+        np.testing.assert_array_equal(np.asarray(t[0].data),
+                                      [0, 365, -1, 19000])
+
+    def test_timestamp_us_ms(self):
+        us = pa.array([0, 10**15, -5], pa.timestamp("us"))
+        ms = pa.array([0, 10**12, -5], pa.timestamp("ms"))
+        t = read_table(write(pa.table({"us": us, "ms": ms})))
+        assert t[0].dtype == T.timestamp_us
+        assert t[1].dtype == T.timestamp_ms
+        np.testing.assert_array_equal(np.asarray(t[0].data), [0, 10**15, -5])
+        np.testing.assert_array_equal(np.asarray(t[1].data), [0, 10**12, -5])
+
+
+class TestListColumns:
+    def test_list_int(self):
+        vals = [[1, 2], [], None, [5], None, [6, 7, 8]]
+        data = write(pa.table({"l": pa.array(vals, pa.list_(pa.int32()))}))
+        t = read_table(data)
+        assert t[0].dtype.id == T.TypeId.LIST
+        assert t[0].to_pylist() == vals
+
+    def test_list_with_null_elements(self):
+        vals = [[1, None, 3], None, [], [None]]
+        data = write(pa.table({"l": pa.array(vals, pa.list_(pa.int64()))}))
+        assert read_table(data)[0].to_pylist() == vals
+
+    def test_list_strings(self):
+        vals = [["ab", "c"], [], None, ["defg", None]]
+        data = write(pa.table({"l": pa.array(vals, pa.list_(pa.string()))}))
+        assert read_table(data)[0].to_pylist() == vals
+
+    def test_list_many_rows_multi_group(self):
+        rng = np.random.default_rng(1)
+        vals = [None if rng.random() < 0.1 else
+                list(rng.integers(0, 100, rng.integers(0, 6)).tolist())
+                for _ in range(5000)]
+        data = write(pa.table({"l": pa.array(vals, pa.list_(pa.int32()))}),
+                     row_group_size=700)
+        assert read_table(data)[0].to_pylist() == vals
+
+    def test_list_of_list_rejected(self):
+        vals = [[[1]], [[2, 3]]]
+        data = write(pa.table(
+            {"l": pa.array(vals, pa.list_(pa.list_(pa.int32())))}))
+        with pytest.raises(NotImplementedError):
+            read_table(data)
+
+    def test_mixed_flat_and_list_with_selection(self):
+        tbl = pa.table({
+            "a": pa.array([1, 2, 3], pa.int64()),
+            "l": pa.array([[1], [], [2, 3]], pa.list_(pa.int32())),
+            "s": pa.array(["x", "y", "z"]),
+        })
+        t = read_table(write(tbl), columns=["s", "l"])
+        assert t[0].to_pylist() == ["x", "y", "z"]
+        assert t[1].to_pylist() == [[1], [], [2, 3]]
+
+
+class TestDeltaUnit:
+    def test_decode_delta_binary_packed_ref(self):
+        # differential vs pyarrow over many shapes, via full files above;
+        # here a hand-built stream: header(block=128, mini=4, count=3,
+        # first=zigzag(5)) + one block
+        import struct
+        buf = bytearray()
+        for v in (128, 4, 3, 10):     # 10 = zigzag(5)
+            while v >= 0x80:
+                buf.append((v & 0x7F) | 0x80)
+                v >>= 7
+            buf.append(v)
+        buf.append(2)                  # min_delta = zigzag^-1(2) = 1
+        buf += bytes([0, 0, 0, 0])     # all miniblock bitwidths 0
+        vals, _ = decode_delta_binary_packed(bytes(buf))
+        np.testing.assert_array_equal(vals, [5, 6, 7])
+
+
+class TestByteArrayDecimal:
+    def test_varlen_byte_array_decimal(self):
+        # parquet-mr/Hive legacy writers store DECIMAL as variable-length
+        # BYTE_ARRAY; craft one by rewriting the schema of an FLBA file is
+        # complex, so build the decode path directly
+        from spark_rapids_jni_tpu.parquet.decode import \
+            _be_varlen_decimal_to_lanes
+        vals = [12345, -1, 0, 2**100, -(2**90)]
+        blobs = [v.to_bytes((v.bit_length() + 8) // 8 or 1, "big",
+                            signed=True) for v in vals]
+        chars = np.frombuffer(b"".join(blobs), np.uint8)
+        lens = np.asarray([len(b) for b in blobs], np.int32)
+        lanes = _be_varlen_decimal_to_lanes(chars, lens)
+        from spark_rapids_jni_tpu.column import Column
+        col = Column(T.decimal128(0), __import__("jax.numpy", fromlist=["x"]).asarray(lanes))
+        assert col.to_pylist() == vals
+
+
+class TestStructSelection:
+    def test_struct_leaves_keep_dotted_paths(self):
+        tbl = pa.table({"s": pa.array([{"a": 1, "b": "x"},
+                                       {"a": 2, "b": "y"}],
+                                      pa.struct([("a", pa.int64()),
+                                                 ("b", pa.string())]))})
+        t = read_table(write(tbl), columns=["s.b", "s.a"])
+        assert t[0].to_pylist() == ["x", "y"]
+        assert t[1].to_pylist() == [1, 2]
