@@ -1,0 +1,75 @@
+"""Mini Mortgage-ETL-shaped raw data generator (BASELINE config #5).
+
+The RAPIDS Mortgage demo ingests Fannie Mae performance + acquisition files
+whose columns arrive as raw TEXT (dates "%m/%d/%Y", decimal rates/balances,
+coded delinquency statuses) and casts them on the accelerator; this
+generator reproduces that shape as parquet STRING columns so the framework's
+``ops.strings`` parse kernels (to_int64/to_decimal/to_date) carry the same
+load the reference's libcudf string-cast kernels do.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+SELLERS = ["BANK OF AMERICA", "WELLS FARGO", "QUICKEN", "OTHER",
+           "JPMORGAN CHASE", "CITIMORTGAGE"]
+STATES = ["CA", "TX", "NY", "FL", "IL", "WA", "OH", "GA"]
+
+
+def _parquet(table: pa.Table) -> bytes:
+    buf = io.BytesIO()
+    pq.write_table(table, buf, compression="SNAPPY")
+    return buf.getvalue()
+
+
+def generate(n_loans: int = 2000, periods_per_loan: int = 12,
+             seed: int = 11) -> dict[str, bytes]:
+    rng = np.random.default_rng(seed)
+
+    loan_ids = np.arange(10**11, 10**11 + n_loans, dtype=np.int64)
+
+    acq = pa.table({
+        "loan_id": pa.array(loan_ids),
+        "orig_interest_rate": pa.array(
+            [f"{r:.4f}" for r in rng.uniform(2.5, 8.0, n_loans)]),
+        "orig_upb": pa.array(
+            [str(u) for u in rng.integers(50_000, 800_000, n_loans)]),
+        "orig_date": pa.array(
+            [f"{rng.integers(2000, 2020)}-{rng.integers(1, 13):02d}-01"
+             for _ in range(n_loans)]),
+        "state": pa.array(
+            [STATES[s] for s in rng.integers(0, len(STATES), n_loans)]),
+        "seller_name": pa.array(
+            [None if rng.random() < 0.05 else
+             SELLERS[s] for s in rng.integers(0, len(SELLERS), n_loans)]),
+    })
+
+    n_perf = n_loans * periods_per_loan
+    perf_loan = np.repeat(loan_ids, periods_per_loan)
+    month = np.tile(np.arange(periods_per_loan), n_loans)
+    years = 2019 + month // 12
+    moys = 1 + month % 12
+    # ~3% of statuses are the unparseable "X" code; ~2% of UPBs are blank —
+    # the raw-data warts the ETL must absorb
+    status_pool = rng.integers(0, 4, n_perf)
+    statuses = np.where(rng.random(n_perf) < 0.03, -1, status_pool)
+    upb = rng.uniform(10_000, 900_000, n_perf)
+    perf = pa.table({
+        "loan_id": pa.array(perf_loan),
+        "monthly_reporting_period": pa.array(
+            [f"{m:02d}/01/{y}" for m, y in zip(moys, years)]),
+        "current_actual_upb": pa.array(
+            ["" if rng.random() < 0.02 else f"{u:.2f}" for u in upb]),
+        "current_loan_delinquency_status": pa.array(
+            ["X" if s < 0 else str(s) for s in statuses]),
+        "servicer_name": pa.array(
+            [None if rng.random() < 0.3 else
+             SELLERS[s] for s in rng.integers(0, len(SELLERS), n_perf)]),
+    })
+
+    return {"perf": _parquet(perf), "acq": _parquet(acq)}

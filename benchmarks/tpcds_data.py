@@ -33,8 +33,15 @@ def generate(n_sales: int = 100_000, n_items: int = 2000,
              seed: int = 42) -> dict[str, bytes]:
     rng = np.random.default_rng(seed)
 
+    import decimal as _dec
     item = pa.table({
         "i_item_sk": pa.array(np.arange(1, n_items + 1, dtype=np.int32)),
+        "i_item_id": pa.array(
+            [f"AAAA{sk:012d}" for sk in range(1, n_items + 1)]),
+        "i_current_price": pa.array(
+            [_dec.Decimal(int(c)) / 100
+             for c in rng.integers(50, 500_00, n_items)],
+            pa.decimal128(7, 2)),     # FLBA decimal → decimal32(-2) decode
         "i_brand_id": pa.array(
             rng.integers(1000, 1100, n_items).astype(np.int32)),
         "i_brand": pa.array(
@@ -66,16 +73,20 @@ def generate(n_sales: int = 100_000, n_items: int = 2000,
 
     # decimal(7,2) measures as int64 cents (decimal64 scale -2)
     price_cents = rng.integers(100, 300_00, n_sales).astype(np.int64)
+    list_cents = price_cents + rng.integers(0, 50_00, n_sales)
     qty = rng.integers(1, 100, n_sales).astype(np.int32)
     store_sales = pa.table({
         "ss_sold_date_sk": pa.array(
             rng.integers(1, n_dates + 1, n_sales).astype(np.int32)),
         "ss_item_sk": pa.array(
             rng.integers(1, n_items + 1, n_sales).astype(np.int32)),
+        # stores 1..n_stores-1 only: the LAST store never sells, so the
+        # left-join query family has a genuinely unmatched dimension row
         "ss_store_sk": pa.array(
-            rng.integers(1, n_stores + 1, n_sales).astype(np.int32)),
+            rng.integers(1, max(n_stores, 2), n_sales).astype(np.int32)),
         "ss_quantity": pa.array(qty),
         "ss_sales_price_cents": pa.array(price_cents),
+        "ss_list_price_cents": pa.array(list_cents),
         "ss_ext_sales_price": pa.array(
             (price_cents * qty).astype(np.float64) / 100.0),
     })

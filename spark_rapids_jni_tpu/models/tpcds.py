@@ -26,9 +26,11 @@ from ..ops import strings as S
 from ..parquet import decode
 
 SS_COLS = ["ss_sold_date_sk", "ss_item_sk", "ss_store_sk", "ss_quantity",
-           "ss_sales_price_cents", "ss_ext_sales_price"]
-ITEM_COLS = ["i_item_sk", "i_brand_id", "i_brand", "i_category_id",
-             "i_category", "i_manufact_id", "i_manager_id"]
+           "ss_sales_price_cents", "ss_list_price_cents",
+           "ss_ext_sales_price"]
+ITEM_COLS = ["i_item_sk", "i_item_id", "i_current_price", "i_brand_id",
+             "i_brand", "i_category_id", "i_category", "i_manufact_id",
+             "i_manager_id"]
 DATE_COLS = ["d_date_sk", "d_year", "d_moy"]
 STORE_COLS = ["s_store_sk", "s_state"]
 
@@ -54,6 +56,21 @@ def _eq_scalar_mask(col: Column, value) -> "np.ndarray":
 
 def _col(cols: list[str], name: str) -> int:
     return cols.index(name)
+
+
+def _range_mask(col: Column, lo=None, hi=None, hi_strict: bool = False):
+    """lo <= col <= hi (either bound optional; ``hi_strict`` makes the
+    upper bound exclusive), null-safe like ``_eq_scalar_mask`` — keeps the
+    validity AND in one place."""
+    m = None
+    if lo is not None:
+        m = col.data >= lo
+    if hi is not None:
+        hm = (col.data < hi) if hi_strict else (col.data <= hi)
+        m = hm if m is None else (m & hm)
+    if col.validity is not None:
+        m = col.validity if m is None else (m & col.validity)
+    return m
 
 
 def _group_sum(joined: Table, cols: list[str], key_names: list[str],
@@ -161,8 +178,110 @@ def q_state_rollup(tables: dict[str, Table], state: str = "TN") -> Table:
     return sort_table(out, [0])
 
 
+def q7(tables: dict[str, Table], year: int = 2000) -> Table:
+    """SELECT i_item_id, avg(ss_quantity), avg(ss_list_price),
+    avg(ss_sales_price) FROM ss ⋈ item ⋈ date WHERE d_year = ?
+    GROUP BY i_item_id ORDER BY i_item_id (Q7 shape: multi-mean)."""
+    ss, item, dd = tables["store_sales"], tables["item"], tables["date_dim"]
+    dd_f = apply_boolean_mask(
+        dd, _eq_scalar_mask(dd[_col(DATE_COLS, "d_year")], year))
+    j1 = inner_join(ss, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
+                    _col(DATE_COLS, "d_date_sk"))
+    cols1 = SS_COLS + DATE_COLS
+    j2 = inner_join(j1, item, cols1.index("ss_item_sk"),
+                    _col(ITEM_COLS, "i_item_sk"))
+    cols = cols1 + ITEM_COLS
+    out = groupby_aggregate(
+        j2, [cols.index("i_item_id")],
+        [(cols.index("ss_quantity"), "mean"),
+         (cols.index("ss_list_price_cents"), "mean"),
+         (cols.index("ss_sales_price_cents"), "mean")])
+    return sort_table(out, [0])
+
+
+def q19(tables: dict[str, Table], year: int = 1999, moy: int = 11,
+        manager_lo: int = 1, manager_hi: int = 50) -> Table:
+    """Brand revenue for a manager-id RANGE in one month (Q19 shape:
+    range predicate + 3-key groupby)."""
+    ss, item, dd = tables["store_sales"], tables["item"], tables["date_dim"]
+    item_f = apply_boolean_mask(
+        item, _range_mask(item[_col(ITEM_COLS, "i_manager_id")],
+                          manager_lo, manager_hi))
+    dd_mask = (_eq_scalar_mask(dd[_col(DATE_COLS, "d_moy")], moy)
+               & _eq_scalar_mask(dd[_col(DATE_COLS, "d_year")], year))
+    dd_f = apply_boolean_mask(dd, dd_mask)
+    j1 = inner_join(ss, item_f, _col(SS_COLS, "ss_item_sk"),
+                    _col(ITEM_COLS, "i_item_sk"))
+    j2 = inner_join(j1, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
+                    _col(DATE_COLS, "d_date_sk"))
+    return _group_sum(j2, SS_COLS + ITEM_COLS + DATE_COLS,
+                      ["i_brand_id", "i_brand", "i_manufact_id"],
+                      "ss_ext_sales_price")
+
+
+def q62(tables: dict[str, Table], year: int = 2000, qty_lo: int = 10,
+        qty_hi: int = 60) -> Table:
+    """Sales counts per month for a quantity band (Q62/Q96 count shape)."""
+    ss, dd = tables["store_sales"], tables["date_dim"]
+    ss_f = apply_boolean_mask(
+        ss, _range_mask(ss[_col(SS_COLS, "ss_quantity")], qty_lo, qty_hi))
+    dd_f = apply_boolean_mask(
+        dd, _eq_scalar_mask(dd[_col(DATE_COLS, "d_year")], year))
+    j = inner_join(ss_f, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
+                   _col(DATE_COLS, "d_date_sk"))
+    cols = SS_COLS + DATE_COLS
+    out = groupby_aggregate(j, [cols.index("d_moy")],
+                            [(cols.index("ss_quantity"), "count")])
+    return sort_table(out, [0])
+
+
+def q52_topn(tables: dict[str, Table], moy: int = 12, year: int = 2001,
+             n: int = 10) -> Table:
+    """Q52 with its ORDER BY sum DESC LIMIT: descending sort on the
+    aggregate + slice (the op library's cudf::slice analog)."""
+    from ..ops import slice_table
+    out = q52(tables, moy=moy, year=year)
+    # columns: d_year, i_brand_id, i_brand, sum — order by sum desc then
+    # brand id asc for a deterministic tie-break
+    ranked = sort_table(out, [3, 1], ascending=[False, True])
+    return slice_table(ranked, 0, n)
+
+
+def q65(tables: dict[str, Table], frac: float = 0.9) -> Table:
+    """Brands whose revenue is below ``frac`` × the mean brand revenue
+    (Q65 shape: aggregate, then compare each group against a global
+    aggregate of the aggregate)."""
+    from ..ops import mean as mean_
+    ss, item = tables["store_sales"], tables["item"]
+    j = inner_join(ss, item, _col(SS_COLS, "ss_item_sk"),
+                   _col(ITEM_COLS, "i_item_sk"))
+    cols = SS_COLS + ITEM_COLS
+    rev = groupby_aggregate(j, [cols.index("i_brand_id")],
+                            [(cols.index("ss_ext_sales_price"), "sum")])
+    threshold = float(np.asarray(mean_(rev[1]))) * frac
+    return sort_table(
+        apply_boolean_mask(rev, _range_mask(rev[1], hi=threshold,
+                                            hi_strict=True)), [0])
+
+
+def q_store_counts(tables: dict[str, Table]) -> Table:
+    """Per-store sale counts INCLUDING stores with no sales (left join →
+    count over a nullable column; Spark's LEFT OUTER + COUNT semantics)."""
+    from ..ops import left_join
+    ss, store = tables["store_sales"], tables["store"]
+    j = left_join(store, ss, _col(STORE_COLS, "s_store_sk"),
+                  _col(SS_COLS, "ss_store_sk"))
+    cols = STORE_COLS + SS_COLS
+    out = groupby_aggregate(
+        j, [cols.index("s_store_sk"), cols.index("s_state")],
+        [(cols.index("ss_item_sk"), "count")])
+    return sort_table(out, [0])
+
+
 QUERIES = {"q3": q3, "q42": q42, "q52": q52, "q55": q55,
-           "q_state_rollup": q_state_rollup}
+           "q_state_rollup": q_state_rollup, "q7": q7, "q19": q19,
+           "q62": q62, "q52_topn": q52_topn, "q65": q65,
+           "q_store_counts": q_store_counts}
 
 
 def run_all(files: dict[str, bytes]) -> dict[str, Table]:

@@ -1,7 +1,8 @@
 from . import hashing  # noqa: F401
 from . import strings  # noqa: F401
 from .cast import cast  # noqa: F401
-from .filter import apply_boolean_mask, gather, mask_table  # noqa: F401
+from .filter import (apply_boolean_mask, fill_null, gather,  # noqa: F401
+                     mask_table)
 from .copying import concat_tables, slice_table  # noqa: F401
 from .groupby import distinct, groupby_aggregate  # noqa: F401
 from .join import (anti_join, inner_join, join_indices, left_join,  # noqa: F401

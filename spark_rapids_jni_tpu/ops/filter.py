@@ -72,3 +72,16 @@ def mask_table(table: Table, mask: jnp.ndarray) -> Table:
         v = mask if c.validity is None else (c.validity & mask)
         cols.append(Column(c.dtype, c.data, c.offsets, v, c.children))
     return Table(cols)
+
+
+def fill_null(col: Column, value) -> Column:
+    """Replace nulls with a scalar (Spark ``coalesce(col, lit)`` / cudf
+    ``replace_nulls``).  Fixed-width columns only."""
+    if (col.dtype.is_variable_width or col.dtype.is_nested
+            or col.dtype.id == T.TypeId.DECIMAL128):
+        raise TypeError(f"fill_null not supported on {col.dtype.id.name}")
+    if col.validity is None:
+        return col
+    data = jnp.where(col.validity, col.data,
+                     jnp.asarray(value, col.data.dtype))
+    return Column(col.dtype, data, validity=None)

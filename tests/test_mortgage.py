@@ -1,0 +1,156 @@
+"""Mortgage ETL differential tests (BASELINE config #5): the framework
+pipeline vs pandas running the same parse/join/aggregate plan over the same
+raw parquet bytes."""
+
+import io
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from benchmarks import mortgage_data
+from spark_rapids_jni_tpu.models import mortgage
+from spark_rapids_jni_tpu.column import Column
+from spark_rapids_jni_tpu.ops import strings as S
+
+
+@pytest.fixture(scope="module")
+def files():
+    return mortgage_data.generate(n_loans=500, periods_per_loan=8, seed=3)
+
+
+@pytest.fixture(scope="module")
+def dfs(files):
+    return {k: pd.read_parquet(io.BytesIO(v)) for k, v in files.items()}
+
+
+def _expected_features(dfs):
+    perf, acq = dfs["perf"].copy(), dfs["acq"].copy()
+    perf["period"] = (pd.to_datetime(perf.monthly_reporting_period,
+                                     format="%m/%d/%Y")
+                      - pd.Timestamp("1970-01-01")).dt.days
+    perf["upb_cents"] = (pd.to_numeric(perf.current_actual_upb,
+                                       errors="coerce") * 100).round()
+    perf["delinq"] = pd.to_numeric(perf.current_loan_delinquency_status,
+                                   errors="coerce").fillna(-1)
+    agg = (perf.groupby("loan_id")
+           .agg(max_delinq=("delinq", "max"),
+                mean_upb=("upb_cents", "mean"),
+                cnt=("loan_id", "count"),
+                first_period=("period", "min")).reset_index())
+    agg["mean_upb"] = agg["mean_upb"] / 100.0
+    acq["rate_e4"] = (pd.to_numeric(acq.orig_interest_rate) * 10**4).round()
+    acq["upb_i"] = pd.to_numeric(acq.orig_upb)
+    acq["odate"] = (pd.to_datetime(acq.orig_date)
+                    - pd.Timestamp("1970-01-01")).dt.days
+    out = acq.merge(agg, on="loan_id").sort_values("loan_id")
+    return out.reset_index(drop=True)
+
+
+def test_etl_matches_pandas(files, dfs):
+    out = mortgage.etl(files)
+    exp = _expected_features(dfs)
+    assert out.num_rows == len(exp)
+    cols = {name: out[i] for i, name in enumerate(mortgage.FEATURE_COLS)}
+    np.testing.assert_array_equal(np.asarray(cols["loan_id"].data),
+                                  exp.loan_id.to_numpy())
+    np.testing.assert_array_equal(np.asarray(cols["orig_rate_e4"].data),
+                                  exp.rate_e4.to_numpy().astype(np.int64))
+    np.testing.assert_array_equal(np.asarray(cols["orig_upb"].data),
+                                  exp.upb_i.to_numpy().astype(np.int64))
+    np.testing.assert_array_equal(np.asarray(cols["orig_date_days"].data),
+                                  exp.odate.to_numpy().astype(np.int32))
+    np.testing.assert_array_equal(np.asarray(cols["max_delinquency"].data),
+                                  exp.max_delinq.to_numpy().astype(np.int64))
+    # mean UPB skips blank (null) rows — pandas mean(skipna) is the oracle
+    np.testing.assert_allclose(np.asarray(cols["mean_upb"].data),
+                               exp.mean_upb.to_numpy(), rtol=1e-9)
+    np.testing.assert_array_equal(np.asarray(cols["num_records"].data),
+                                  exp.cnt.to_numpy().astype(np.int64))
+    np.testing.assert_array_equal(np.asarray(cols["first_period_days"].data),
+                                  exp.first_period.to_numpy().astype(np.int32))
+
+
+def test_categorical_codes_consistent(files, dfs):
+    out = mortgage.etl(files)
+    exp = _expected_features(dfs)
+    state_codes = np.asarray(
+        out[mortgage.FEATURE_COLS.index("state_code")].data)
+    # dictionary codes are order-preserving ranks: equal states ⇔ equal codes
+    df = pd.DataFrame({"state": exp.state.to_numpy(), "code": state_codes})
+    assert (df.groupby("state").code.nunique() == 1).all()
+    assert (df.groupby("code").state.nunique() == 1).all()
+    # null sellers land in the -1 bucket
+    seller_codes = np.asarray(
+        out[mortgage.FEATURE_COLS.index("seller_code")].data)
+    null_mask = exp.seller_name.isna().to_numpy()
+    assert (seller_codes[null_mask] == -1).all()
+    assert (seller_codes[~null_mask] >= 0).all()
+
+
+def test_feature_matrix_shape(files):
+    ids, mat = mortgage.feature_matrix(files)
+    assert mat.shape == (500, len(mortgage.FEATURE_COLS) - 1)
+    assert ids.shape[0] == 500
+    assert not np.isnan(np.asarray(mat)).any()
+
+
+class TestParseKernels:
+    def test_to_int64_matches_python(self):
+        vals = ["0", "-1", "123456789012345678", "+42", "", "9x", "--1",
+                None, "007"]
+        out = S.to_int64(Column.strings_from_list(vals))
+        want = [0, -1, 123456789012345678, 42, None, None, None, None, 7]
+        assert out.to_pylist() == want
+
+    def test_to_decimal_matches_python(self):
+        vals = ["3.14159", "-2.5", "100", "0.005", "1.", ".25", "1.2.3",
+                None, "abc"]
+        out = S.to_decimal(Column.strings_from_list(vals), -3)
+        want = [3142, -2500, 100000, 5, 1000, 250, None, None, None]
+        assert out.to_pylist() == want
+
+    def test_to_date_roundtrip_numpy(self):
+        rng = np.random.default_rng(0)
+        days = rng.integers(-20000, 40000, 500)
+        dates = (np.datetime64("1970-01-01") + days).astype("datetime64[D]")
+        iso = [str(d) for d in dates]
+        out = S.to_date(Column.strings_from_list(iso))
+        np.testing.assert_array_equal(np.asarray(out.data), days)
+        mdy = [f"{d.astype(object).month:02d}/{d.astype(object).day:02d}/"
+               f"{d.astype(object).year:04d}" for d in dates]
+        out2 = S.to_date(Column.strings_from_list(mdy), "%m/%d/%Y")
+        np.testing.assert_array_equal(np.asarray(out2.data), days)
+
+
+class TestParseStrictness:
+    def test_to_int64_overflow_is_null(self):
+        vals = ["99999999999999999999", "9223372036854775808",
+                "000000000000000000005", "123456789012345678"]
+        out = S.to_int64(Column.strings_from_list(vals))
+        # >18 significant digits → null (conservative Spark CAST);
+        # leading zeros don't count as significant
+        assert out.to_pylist() == [None, None, 5, 123456789012345678]
+
+    def test_to_decimal_overflow_is_null(self):
+        out = S.to_decimal(Column.strings_from_list(
+            ["99999999999999999999.5", "1.5"]), -3)
+        assert out.to_pylist() == [None, 1500]
+
+    def test_to_date_rejects_impossible_dates(self):
+        vals = ["2021-02-31", "2020-02-29", "2019-02-29", "2021-04-31",
+                "2020/01/02", "2020-1x-02", "2020-01-02"]
+        out = S.to_date(Column.strings_from_list(vals))
+        assert out.to_pylist() == [None, 18321, None, None, None, None,
+                                   18263]
+
+    def test_to_date_mdy_separators(self):
+        out = S.to_date(Column.strings_from_list(
+            ["02/29/2020", "02-29-2020", "13/01/2020"]), "%m/%d/%Y")
+        assert out.to_pylist() == [18321, None, None]
+
+    def test_fill_null_decimal128_rejected(self):
+        from spark_rapids_jni_tpu.ops import decimal128 as d128
+        from spark_rapids_jni_tpu.ops import fill_null
+        with pytest.raises(TypeError):
+            fill_null(d128.from_pyints([1, None]), 0)

@@ -112,6 +112,90 @@ def test_q_state_rollup(tables, dfs):
                     ("qcount", "float")])
 
 
+def test_q7(tables, dfs):
+    out = tpcds.q7(tables, year=2000)
+    ss, item, dd = dfs["store_sales"], dfs["item"], dfs["date_dim"]
+    j = (ss.merge(dd[dd.d_year == 2000], left_on="ss_sold_date_sk",
+                  right_on="d_date_sk")
+         .merge(item, left_on="ss_item_sk", right_on="i_item_sk"))
+    exp = (j.groupby(["i_item_id"], as_index=False)
+           .agg(q=("ss_quantity", "mean"),
+                lp=("ss_list_price_cents", "mean"),
+                sp=("ss_sales_price_cents", "mean")))
+    _assert_result(out, exp, ["i_item_id"],
+                   [("q", "float"), ("lp", "float"), ("sp", "float")])
+
+
+def test_q19(tables, dfs):
+    out = tpcds.q19(tables, year=1999, moy=11, manager_lo=1, manager_hi=50)
+    ss, item, dd = dfs["store_sales"], dfs["item"], dfs["date_dim"]
+    j = (ss.merge(item[(item.i_manager_id >= 1) & (item.i_manager_id <= 50)],
+                  left_on="ss_item_sk", right_on="i_item_sk")
+         .merge(dd[(dd.d_moy == 11) & (dd.d_year == 1999)],
+                left_on="ss_sold_date_sk", right_on="d_date_sk"))
+    exp = (j.groupby(["i_brand_id", "i_brand", "i_manufact_id"],
+                     as_index=False)["ss_ext_sales_price"].sum())
+    _assert_result(out, exp, ["i_brand_id", "i_brand", "i_manufact_id"],
+                   [("ss_ext_sales_price", "float")])
+
+
+def test_q62(tables, dfs):
+    out = tpcds.q62(tables, year=2000, qty_lo=10, qty_hi=60)
+    ss, dd = dfs["store_sales"], dfs["date_dim"]
+    j = (ss[(ss.ss_quantity >= 10) & (ss.ss_quantity <= 60)]
+         .merge(dd[dd.d_year == 2000], left_on="ss_sold_date_sk",
+                right_on="d_date_sk"))
+    exp = (j.groupby(["d_moy"], as_index=False)
+           .agg(cnt=("ss_quantity", "count")))
+    _assert_result(out, exp, ["d_moy"], [("cnt", "float")])
+
+
+def test_q52_topn(tables, dfs):
+    out = tpcds.q52_topn(tables, moy=12, year=2001, n=5)
+    ss, item, dd = dfs["store_sales"], dfs["item"], dfs["date_dim"]
+    j = (ss.merge(dd[(dd.d_moy == 12) & (dd.d_year == 2001)],
+                  left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(item, left_on="ss_item_sk", right_on="i_item_sk"))
+    exp = (j.groupby(["d_year", "i_brand_id", "i_brand"], as_index=False)
+           ["ss_ext_sales_price"].sum()
+           .sort_values(["ss_ext_sales_price", "i_brand_id"],
+                        ascending=[False, True]).head(5)
+           .reset_index(drop=True))
+    assert out.num_rows == len(exp)
+    assert out[1].to_numpy().tolist() == exp["i_brand_id"].tolist()
+    np.testing.assert_allclose(np.asarray(out[3].to_numpy()),
+                               exp["ss_ext_sales_price"].to_numpy(),
+                               rtol=1e-9)
+
+
+def test_q65(tables, dfs):
+    out = tpcds.q65(tables, frac=0.9)
+    ss, item = dfs["store_sales"], dfs["item"]
+    j = ss.merge(item, left_on="ss_item_sk", right_on="i_item_sk")
+    rev = (j.groupby(["i_brand_id"], as_index=False)
+           ["ss_ext_sales_price"].sum())
+    thr = rev["ss_ext_sales_price"].mean() * 0.9
+    exp = (rev[rev.ss_ext_sales_price < thr]
+           .sort_values("i_brand_id").reset_index(drop=True))
+    _assert_result(out, exp, ["i_brand_id"],
+                   [("ss_ext_sales_price", "float")])
+
+
+def test_q_store_counts(tables, dfs):
+    out = tpcds.q_store_counts(tables)
+    ss, store = dfs["store_sales"], dfs["store"]
+    j = store.merge(ss, left_on="s_store_sk", right_on="ss_store_sk",
+                    how="left")
+    exp = (j.groupby(["s_store_sk", "s_state"], as_index=False)
+           .agg(cnt=("ss_item_sk", "count"))
+           .sort_values("s_store_sk").reset_index(drop=True))
+    assert out.num_rows == len(exp)
+    assert out[0].to_numpy().tolist() == exp["s_store_sk"].tolist()
+    assert out[2].to_numpy().tolist() == exp["cnt"].tolist()
+    # the never-selling store must appear with count 0
+    assert 0 in out[2].to_numpy().tolist()
+
+
 def test_run_all_smoke(files):
     # spec-default parameters may select nothing at this mini scale — an
     # empty result is a valid result (Spark returns empty, not an error)
