@@ -104,8 +104,6 @@ def feature_matrix(files: dict[str, bytes]):
     t = etl(files)
     lanes = []
     for c in t.columns[1:]:
-        data = c.data
-        if c.dtype.is_decimal and c.dtype.id != T.TypeId.DECIMAL128:
-            data = cast(c, T.float64).data
+        data = cast(c, T.float64).data if c.dtype.is_decimal else c.data
         lanes.append(data.astype(jnp.float32))
     return t[0].data, jnp.stack(lanes, axis=1)

@@ -21,7 +21,7 @@ import numpy as np
 from .. import types as T
 from ..column import Column, Table
 from ..ops import (apply_boolean_mask, groupby_aggregate, inner_join,
-                   sort_table)
+                   left_join, mean, slice_table, sort_table)
 from ..ops import strings as S
 from ..parquet import decode
 
@@ -239,7 +239,6 @@ def q52_topn(tables: dict[str, Table], moy: int = 12, year: int = 2001,
              n: int = 10) -> Table:
     """Q52 with its ORDER BY sum DESC LIMIT: descending sort on the
     aggregate + slice (the op library's cudf::slice analog)."""
-    from ..ops import slice_table
     out = q52(tables, moy=moy, year=year)
     # columns: d_year, i_brand_id, i_brand, sum — order by sum desc then
     # brand id asc for a deterministic tie-break
@@ -251,14 +250,13 @@ def q65(tables: dict[str, Table], frac: float = 0.9) -> Table:
     """Brands whose revenue is below ``frac`` × the mean brand revenue
     (Q65 shape: aggregate, then compare each group against a global
     aggregate of the aggregate)."""
-    from ..ops import mean as mean_
     ss, item = tables["store_sales"], tables["item"]
     j = inner_join(ss, item, _col(SS_COLS, "ss_item_sk"),
                    _col(ITEM_COLS, "i_item_sk"))
     cols = SS_COLS + ITEM_COLS
     rev = groupby_aggregate(j, [cols.index("i_brand_id")],
                             [(cols.index("ss_ext_sales_price"), "sum")])
-    threshold = float(np.asarray(mean_(rev[1]))) * frac
+    threshold = float(np.asarray(mean(rev[1]))) * frac
     return sort_table(
         apply_boolean_mask(rev, _range_mask(rev[1], hi=threshold,
                                             hi_strict=True)), [0])
@@ -267,7 +265,6 @@ def q65(tables: dict[str, Table], frac: float = 0.9) -> Table:
 def q_store_counts(tables: dict[str, Table]) -> Table:
     """Per-store sale counts INCLUDING stores with no sales (left join →
     count over a nullable column; Spark's LEFT OUTER + COUNT semantics)."""
-    from ..ops import left_join
     ss, store = tables["store_sales"], tables["store"]
     j = left_join(store, ss, _col(STORE_COLS, "s_store_sk"),
                   _col(SS_COLS, "ss_store_sk"))

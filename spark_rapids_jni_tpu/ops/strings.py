@@ -274,6 +274,28 @@ def concat(a: Column, b: Column) -> Column:
 # to_timestamps analog — the Mortgage-ETL cast path, BASELINE config #5)
 # ---------------------------------------------------------------------------
 
+def _trimmed(mat: jnp.ndarray, lens: jnp.ndarray):
+    """Left-justify each row past its leading spaces and drop trailing
+    spaces from the length — Spark CAST trims whitespace before parsing
+    (UTF8String.trimAll).  One gather, stays vectorized."""
+    j = jnp.arange(mat.shape[1], dtype=jnp.int32)
+    in_row = j[None, :] < lens[:, None]
+    is_space = (mat == ord(" ")) | (mat == ord("\t"))
+    lead = jnp.sum(jnp.cumprod((is_space & in_row).astype(jnp.int32),
+                               axis=1), axis=1)
+    # trailing spaces: contiguous suffix of the row that is all spaces
+    tail_space = is_space | ~in_row
+    trail = (jnp.sum(jnp.cumprod(tail_space[:, ::-1].astype(jnp.int32),
+                                 axis=1), axis=1)
+             - (mat.shape[1] - lens))
+    new_lens = jnp.maximum(lens - lead - jnp.maximum(trail, 0), 0)
+    src = jnp.clip(j[None, :] + lead[:, None], 0, mat.shape[1] - 1)
+    shifted = jnp.take_along_axis(mat, src, axis=1)
+    shifted = jnp.where(j[None, :] < new_lens[:, None], shifted,
+                        jnp.uint8(0))
+    return shifted, new_lens.astype(lens.dtype)
+
+
 def _digit_scan(mat: jnp.ndarray, lens: jnp.ndarray):
     """Per-row digit parse state over the padded byte matrix.
 
@@ -297,6 +319,7 @@ def to_int64(col: Column) -> Column:
     Spark CAST semantics).  Fully vectorized: one weight per byte position
     (10^(#digits to the right)), one masked dot product per row."""
     mat, lens = byte_matrix(col)
+    mat, lens = _trimmed(mat, lens)
     digits, neg, is_digit = _digit_scan(mat, lens)
     # a row is valid iff it has ≥1 digit and nothing but sign+digits
     j = jnp.arange(mat.shape[1], dtype=jnp.int32)
@@ -328,6 +351,7 @@ def to_decimal(col: Column, scale: int) -> Column:
     """Parse "123.45"-style strings → DECIMAL64(scale) with round-half-up
     when the text has more fractional digits than ``scale`` keeps."""
     mat, lens = byte_matrix(col)
+    mat, lens = _trimmed(mat, lens)
     digits, neg, is_digit = _digit_scan(mat, lens)
     j = jnp.arange(mat.shape[1], dtype=jnp.int32)
     in_row = j[None, :] < lens[:, None]
@@ -358,8 +382,10 @@ def to_decimal(col: Column, scale: int) -> Column:
     ok = ok & (_significant_digits(digits, int_digit) + keep <= 18)
     weight = jnp.where(kept, 10 ** jnp.clip(exp, 0, 18), 0)
     vals = jnp.sum(jnp.where(kept, digits, 0) * weight, axis=1)
-    # round half up on the first dropped digit
-    first_drop = is_digit & (exp == -1) & after_dot & (frac_pos == keep + 1)
+    # round half up on the first dropped digit — exp == -1 identifies it in
+    # both regimes: the (keep+1)-th fractional digit for negative scales,
+    # and the most significant dropped INTEGER digit for positive scales
+    first_drop = is_digit & (exp == -1)
     roundup = jnp.sum(jnp.where(first_drop, digits, 0), axis=1) >= 5
     vals = vals + roundup.astype(jnp.int64)
     vals = jnp.where(neg, -vals, vals)

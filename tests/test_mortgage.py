@@ -154,3 +154,12 @@ class TestParseStrictness:
         from spark_rapids_jni_tpu.ops import fill_null
         with pytest.raises(TypeError):
             fill_null(d128.from_pyints([1, None]), 0)
+
+    def test_whitespace_trimmed_like_spark(self):
+        out = S.to_int64(Column.strings_from_list([" 42", "42 ", "  -7  ",
+                                                   " ", "1 2"]))
+        assert out.to_pylist() == [42, 42, -7, None, None]
+
+    def test_to_decimal_positive_scale_rounds(self):
+        out = S.to_decimal(Column.strings_from_list(["255", "244", "-255"]), 1)
+        assert out.to_pylist() == [26, 24, -26]
