@@ -1,5 +1,6 @@
 from . import hashing  # noqa: F401
 from . import strings  # noqa: F401
+from . import window  # noqa: F401
 from .cast import cast  # noqa: F401
 from .filter import (apply_boolean_mask, fill_null, gather,  # noqa: F401
                      mask_table)

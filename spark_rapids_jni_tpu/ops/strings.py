@@ -280,7 +280,9 @@ def _trimmed(mat: jnp.ndarray, lens: jnp.ndarray):
     (UTF8String.trimAll).  One gather, stays vectorized."""
     j = jnp.arange(mat.shape[1], dtype=jnp.int32)
     in_row = j[None, :] < lens[:, None]
-    is_space = (mat == ord(" ")) | (mat == ord("\t"))
+    # Spark UTF8String.trimAll strips all ASCII whitespace: space, \t, \n,
+    # \v, \f, \r
+    is_space = (mat == ord(" ")) | ((mat >= 9) & (mat <= 13))
     lead = jnp.sum(jnp.cumprod((is_space & in_row).astype(jnp.int32),
                                axis=1), axis=1)
     # trailing spaces: contiguous suffix of the row that is all spaces

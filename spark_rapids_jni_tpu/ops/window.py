@@ -1,0 +1,238 @@
+"""Window functions (libcudf rolling/grouped-window analog, Spark
+``OVER (PARTITION BY … ORDER BY …)`` semantics).
+
+TPU-first formulation: one lexsort puts rows in (partition, order) order,
+then every window primitive is a *segmented scan* — a plain prefix scan
+corrected at segment heads — so each costs O(n) fused vector work and no
+per-partition loops.  Results are scattered back to the input row order.
+
+Supported: row_number, rank, dense_rank, lag/lead, and partitioned running
+sum/min/max/count (the grouped-rolling slice the Spark plugin uses most).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..column import Column, Table
+from .scan import jax_cummax
+from .sort import order_by
+
+
+class WindowSpec:
+    """Resolved window: rows pre-sorted by (partition, order), with the
+    inverse permutation to scatter results back to input order."""
+
+    def __init__(self, table: Table, partition_by: Sequence[int],
+                 order_by_keys: Sequence[int],
+                 ascending: Sequence[bool] | None = None):
+        self.table = table
+        n = table.num_rows
+        keys = list(partition_by) + list(order_by_keys)
+        asc = ([True] * len(partition_by)
+               + (list(ascending) if ascending else
+                  [True] * len(order_by_keys)))
+        self.order = order_by(table, keys, asc)
+        self.inv = jnp.zeros(n, jnp.int32).at[self.order].set(
+            jnp.arange(n, dtype=jnp.int32))
+        # segment heads: partition-key change between adjacent sorted rows
+        head = jnp.zeros(n, dtype=jnp.bool_)
+        if n:
+            head = head.at[0].set(True)
+        for ki in partition_by:
+            col = table[ki]
+            if col.dtype.id == T.TypeId.DECIMAL128:
+                k = col.data[self.order]
+                neq = (k[1:] != k[:-1]).any(axis=1)
+            elif col.dtype.is_variable_width:
+                from . import strings
+                codes, _ = strings.dictionary_encode(col)
+                k = codes.data[self.order]
+                neq = k[1:] != k[:-1]
+            else:
+                k = col.data[self.order]
+                neq = k[1:] != k[:-1]
+            v = col.validity
+            if v is not None:
+                sv = v[self.order]
+                neq = neq | (sv[1:] != sv[:-1])
+            head = head.at[1:].max(neq)
+        self.head = head
+        self.seg_id = jnp.cumsum(head.astype(jnp.int32)) - 1
+
+    # -- segmented-scan core ------------------------------------------------
+    def _seg_base(self, scanned: jnp.ndarray) -> jnp.ndarray:
+        """Per-row value of ``scanned`` at the row's segment head minus one
+        step — the correction that turns a global scan into a segmented one.
+        ``scanned`` must be an INCLUSIVE global scan."""
+        n = scanned.shape[0]
+        head_pos = jnp.where(self.head, jnp.arange(n, dtype=jnp.int32), 0)
+        head_pos = jax_cummax(head_pos)
+        prev = jnp.where(head_pos > 0, scanned[jnp.maximum(head_pos - 1, 0)],
+                         jnp.zeros((), scanned.dtype))
+        return jnp.where(head_pos > 0, prev, jnp.zeros((), scanned.dtype))
+
+    def _to_input_order(self, sorted_vals: jnp.ndarray,
+                        dtype: T.DType, validity=None) -> Column:
+        vals = sorted_vals[self.inv]
+        v = None if validity is None else validity[self.inv]
+        return Column(dtype, vals.astype(dtype.storage), validity=v)
+
+
+def row_number(spec: WindowSpec) -> Column:
+    """1-based position within the partition (Spark row_number())."""
+    n = spec.table.num_rows
+    pos = jnp.arange(n, dtype=jnp.int64) + 1
+    base = spec._seg_base(pos)
+    return spec._to_input_order(pos - base, T.int64)
+
+
+def _order_change(spec: WindowSpec, order_keys: Sequence[int]) -> jnp.ndarray:
+    """bool [n]: sorted row differs from its predecessor on the ORDER keys
+    (or starts a partition) — the tie boundary for rank/dense_rank."""
+    n = spec.table.num_rows
+    change = spec.head
+    for ki in order_keys:
+        col = spec.table[ki]
+        if col.dtype.is_variable_width:
+            from . import strings
+            codes, _ = strings.dictionary_encode(col)
+            k = codes.data[spec.order]
+        else:
+            k = col.data[spec.order]
+        if k.ndim == 2:   # decimal128 lanes
+            neq = (k[1:] != k[:-1]).any(axis=1)
+        else:
+            neq = k[1:] != k[:-1]
+        if col.validity is not None:
+            # NULL is its own rank value (Spark: null sorts distinctly) —
+            # a validity flip between adjacent rows is an order change
+            sv = col.validity[spec.order]
+            neq = neq | (sv[1:] != sv[:-1])
+        change = change.at[1:].max(neq)
+    return change
+
+
+def rank(spec: WindowSpec, order_keys: Sequence[int]) -> Column:
+    """Spark rank(): ties share a rank, gaps after ties."""
+    n = spec.table.num_rows
+    change = _order_change(spec, order_keys)
+    pos = jnp.arange(n, dtype=jnp.int64) + 1
+    # rank = row number of the first row of the tie run, within partition
+    run_start = jax_cummax(jnp.where(change, pos, 0))
+    base = spec._seg_base(pos)
+    return spec._to_input_order(run_start - base, T.int64)
+
+
+def dense_rank(spec: WindowSpec, order_keys: Sequence[int]) -> Column:
+    """Spark dense_rank(): ties share a rank, no gaps."""
+    change = _order_change(spec, order_keys)
+    distinct = jnp.cumsum(change.astype(jnp.int64))
+    base = spec._seg_base(distinct)
+    return spec._to_input_order(distinct - base, T.int64)
+
+
+def lag(spec: WindowSpec, value_col: int, offset: int = 1) -> Column:
+    """Value ``offset`` rows earlier in the partition; null at the head."""
+    return _shift(spec, value_col, offset)
+
+
+def lead(spec: WindowSpec, value_col: int, offset: int = 1) -> Column:
+    """Value ``offset`` rows later in the partition; null at the tail."""
+    return _shift(spec, value_col, -offset)
+
+
+def _shift(spec: WindowSpec, value_col: int, offset: int) -> Column:
+    col = spec.table[value_col]
+    if col.dtype.is_variable_width or col.dtype.is_nested:
+        raise TypeError(f"lag/lead not supported on {col.dtype.id.name}")
+    n = col.num_rows
+    idx = jnp.arange(n, dtype=jnp.int32)
+    src = idx - offset
+    in_bounds = (src >= 0) & (src < n)
+    src_c = jnp.clip(src, 0, max(n - 1, 0))
+    sorted_vals = col.data[spec.order][src_c]
+    # crossing a partition boundary is out-of-window → null
+    same_part = spec.seg_id == spec.seg_id[src_c]
+    ok = in_bounds & same_part
+    sv = col.validity
+    if sv is not None:
+        ok = ok & sv[spec.order][src_c]
+    return spec._to_input_order(sorted_vals, col.dtype, validity=ok)
+
+
+def _check_scannable(col: Column) -> None:
+    if (col.dtype.is_variable_width or col.dtype.is_nested
+            or col.dtype.id == T.TypeId.DECIMAL128):
+        raise TypeError(
+            f"window scans not supported on {col.dtype.id.name}")
+
+
+def running_sum(spec: WindowSpec, value_col: int) -> Column:
+    """Partitioned running sum over the window order (nulls contribute 0,
+    stay null — the scan EXCLUDE policy, see ops.scan)."""
+    col = spec.table[value_col]
+    _check_scannable(col)
+    acc_dt = (T.decimal64(col.dtype.scale) if col.dtype.is_decimal
+              else T.float64 if col.dtype.storage.kind == "f"
+              else T.int64)
+    data = col.data[spec.order].astype(acc_dt.storage)
+    sv = None if col.validity is None else col.validity[spec.order]
+    if sv is not None:
+        data = jnp.where(sv, data, 0)
+    scanned = jnp.cumsum(data)
+    out = scanned - spec._seg_base(scanned)
+    return spec._to_input_order(out, acc_dt, validity=sv)
+
+
+def running_count(spec: WindowSpec, value_col: int) -> Column:
+    col = spec.table[value_col]
+    ones = (col.validity[spec.order].astype(jnp.int64)
+            if col.validity is not None
+            else jnp.ones((col.num_rows,), jnp.int64))
+    scanned = jnp.cumsum(ones)
+    return spec._to_input_order(scanned - spec._seg_base(scanned), T.int64)
+
+
+def _running_extreme(spec: WindowSpec, value_col: int, is_max: bool) -> Column:
+    """Segmented cummax/cummin: associative scan over (reset, value) pairs
+    — max/min has no subtraction trick, so segment heads carry a reset flag
+    through the scan instead."""
+    col = spec.table[value_col]
+    _check_scannable(col)
+    data = col.data[spec.order]
+    sv = None if col.validity is None else col.validity[spec.order]
+    kind = col.dtype.storage.kind
+    if is_max:
+        ident = (-jnp.inf if kind == "f"
+                 else np.iinfo(np.dtype(col.dtype.storage)).min)
+        combine = jnp.maximum
+    else:
+        ident = (jnp.inf if kind == "f"
+                 else np.iinfo(np.dtype(col.dtype.storage)).max)
+        combine = jnp.minimum
+    if sv is not None:
+        data = jnp.where(sv, data, jnp.asarray(ident, data.dtype))
+
+    def op(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, combine(va, vb))
+
+    _, out = jax.lax.associative_scan(op, (spec.head, data))
+    return spec._to_input_order(out, col.dtype, validity=sv)
+
+
+def running_max(spec: WindowSpec, value_col: int) -> Column:
+    """Partitioned running max (nulls skipped, stay null)."""
+    return _running_extreme(spec, value_col, True)
+
+
+def running_min(spec: WindowSpec, value_col: int) -> Column:
+    """Partitioned running min (nulls skipped, stay null)."""
+    return _running_extreme(spec, value_col, False)

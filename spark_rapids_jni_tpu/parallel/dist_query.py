@@ -20,7 +20,7 @@ sharded executor pool) in miniature.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import NamedTuple
 
 import jax
@@ -93,10 +93,18 @@ def distributed_star_agg(mesh: jax.sharding.Mesh, dim: Dimension,
     Returns replicated ([num_groups] sums, [num_groups] counts) — group
     codes index them.
     """
+    fn = _compiled_star_agg(mesh, dim.num_groups, axis_name)
+    return fn(dim.keys, dim.group_codes, fact_key, fact_value)
+
+
+@lru_cache(maxsize=64)
+def _compiled_star_agg(mesh, num_groups: int, axis_name: str):
+    """jitted program cached on (mesh, num_groups, axis) — rebuilding the
+    shard_map wrapper per call would retrace every invocation."""
     P = jax.sharding.PartitionSpec
     fn = jax.shard_map(
-        partial(_local_star_agg, dim.num_groups, axis_name),
+        partial(_local_star_agg, num_groups, axis_name),
         mesh=mesh,
         in_specs=(P(), P(), P(axis_name), P(axis_name)),
         out_specs=(P(), P()))
-    return jax.jit(fn)(dim.keys, dim.group_codes, fact_key, fact_value)
+    return jax.jit(fn)

@@ -100,3 +100,12 @@ def test_duplicate_dimension_keys_rejected():
         prepare_dimension(
             Column.from_numpy(np.asarray([1, 1, 2], np.int64)),
             Column.from_numpy(np.asarray([0, 1, 0], np.int32)))
+
+
+def test_compiled_program_is_cached():
+    from spark_rapids_jni_tpu.parallel.dist_query import _compiled_star_agg
+    mesh = make_mesh(8)
+    assert (_compiled_star_agg(mesh, 5, "data")
+            is _compiled_star_agg(mesh, 5, "data"))
+    assert (_compiled_star_agg(mesh, 5, "data")
+            is not _compiled_star_agg(mesh, 6, "data"))

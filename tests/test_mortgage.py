@@ -163,3 +163,8 @@ class TestParseStrictness:
     def test_to_decimal_positive_scale_rounds(self):
         out = S.to_decimal(Column.strings_from_list(["255", "244", "-255"]), 1)
         assert out.to_pylist() == [26, 24, -26]
+
+    def test_all_ascii_whitespace_trimmed(self):
+        out = S.to_int64(Column.strings_from_list(
+            ["42\n", "\r42", "\t42\x0b", "4\n2"]))
+        assert out.to_pylist() == [42, 42, 42, None]

@@ -1,0 +1,134 @@
+"""Window functions — differential vs pandas groupby windows."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu.ops import window as W
+
+
+def _data(n=400, parts=7, seed=0):
+    rng = np.random.default_rng(seed)
+    part = rng.integers(0, parts, n).astype(np.int32)
+    order_key = rng.integers(0, 50, n).astype(np.int64)
+    vals = rng.integers(-100, 100, n).astype(np.int64)
+    valid = rng.random(n) < 0.85
+    t = Table([Column.from_numpy(part), Column.from_numpy(order_key),
+               Column.from_numpy(vals, validity=valid)])
+    df = pd.DataFrame({"p": part, "o": order_key,
+                       "v": np.where(valid, vals, np.nan)})
+    return t, df
+
+
+@pytest.fixture(scope="module")
+def spec_and_df():
+    t, df = _data()
+    return W.WindowSpec(t, [0], [1]), df
+
+
+def test_row_number(spec_and_df):
+    spec, df = spec_and_df
+    got = np.asarray(W.row_number(spec).data)
+    # pandas: stable sort by (p, o) then cumcount within p
+    df2 = df.copy()
+    df2["rn"] = (df.sort_values(["p", "o"], kind="stable")
+                 .groupby("p").cumcount() + 1)
+    np.testing.assert_array_equal(got, df2["rn"].to_numpy())
+
+
+def test_rank_and_dense_rank(spec_and_df):
+    spec, df = spec_and_df
+    got_r = np.asarray(W.rank(spec, [1]).data)
+    got_d = np.asarray(W.dense_rank(spec, [1]).data)
+    want_r = df.groupby("p")["o"].rank(method="min").to_numpy()
+    want_d = df.groupby("p")["o"].rank(method="dense").to_numpy()
+    np.testing.assert_array_equal(got_r, want_r.astype(np.int64))
+    np.testing.assert_array_equal(got_d, want_d.astype(np.int64))
+
+
+def test_running_sum_and_count(spec_and_df):
+    spec, df = spec_and_df
+    got = np.asarray(W.running_sum(spec, 2).data)
+    got_c = np.asarray(W.running_count(spec, 2).data)
+    df2 = df.sort_values(["p", "o"], kind="stable").copy()
+    df2["rs"] = df2.groupby("p")["v"].transform(
+        lambda s: s.fillna(0).cumsum())
+    df2["rc"] = df2.groupby("p")["v"].transform(
+        lambda s: s.notna().cumsum())
+    back = df2.sort_index()
+    np.testing.assert_array_equal(got, back["rs"].to_numpy().astype(np.int64))
+    np.testing.assert_array_equal(got_c, back["rc"].to_numpy())
+
+
+def test_lag_lead_roundtrip():
+    # deterministic tiny case with string partitions
+    part = Column.strings_from_list(["a", "b", "a", "b", "a"])
+    order_key = Column.from_numpy(np.asarray([1, 1, 2, 2, 3], np.int64))
+    vals = Column.from_numpy(np.asarray([10, 20, 30, 40, 50], np.int64))
+    t = Table([part, order_key, vals])
+    spec = W.WindowSpec(t, [0], [1])
+    assert W.lag(spec, 2).to_pylist() == [None, None, 10, 20, 30]
+    assert W.lead(spec, 2).to_pylist() == [30, 40, 50, None, None]
+    assert W.lag(spec, 2, offset=2).to_pylist() == [None, None, None, None, 10]
+
+
+def test_lag_null_values_stay_null():
+    part = Column.from_numpy(np.zeros(3, np.int32))
+    order_key = Column.from_numpy(np.arange(3, dtype=np.int64))
+    vals = Column.from_numpy(np.asarray([1, 0, 3], np.int64),
+                             validity=np.asarray([True, False, True]))
+    spec = W.WindowSpec(Table([part, order_key, vals]), [0], [1])
+    assert W.lag(spec, 2).to_pylist() == [None, 1, None]
+
+
+def test_descending_order():
+    part = Column.from_numpy(np.zeros(4, np.int32))
+    order_key = Column.from_numpy(np.asarray([1, 2, 3, 4], np.int64))
+    vals = Column.from_numpy(np.asarray([10, 20, 30, 40], np.int64))
+    spec = W.WindowSpec(Table([part, order_key, vals]), [0], [1],
+                        ascending=[False])
+    got = np.asarray(W.row_number(spec).data)
+    np.testing.assert_array_equal(got, [4, 3, 2, 1])
+
+
+class TestReviewRegressions:
+    def test_rank_null_order_key_is_distinct(self):
+        # NULL order key vs a valid row with the same stored payload:
+        # Spark ranks them separately (null sorts first)
+        part = Column.from_numpy(np.zeros(2, np.int32))
+        ok = Column.from_numpy(np.zeros(2, np.int64),
+                               validity=np.asarray([False, True]))
+        t = Table([part, ok])
+        spec = W.WindowSpec(t, [0], [1])
+        assert np.asarray(W.rank(spec, [1]).data).tolist() == [1, 2]
+        assert np.asarray(W.dense_rank(spec, [1]).data).tolist() == [1, 2]
+
+    def test_running_sum_decimal128_rejected(self):
+        from spark_rapids_jni_tpu.ops import decimal128 as d128
+        col = d128.from_pyints([1, 2])
+        t = Table([Column.from_numpy(np.zeros(2, np.int32)),
+                   Column.from_numpy(np.arange(2, dtype=np.int64)), col])
+        spec = W.WindowSpec(t, [0], [1])
+        with pytest.raises(TypeError, match="DECIMAL128"):
+            W.running_sum(spec, 2)
+
+    def test_running_min_max_match_pandas(self):
+        rng = np.random.default_rng(3)
+        n = 300
+        part = rng.integers(0, 6, n).astype(np.int32)
+        ok = rng.integers(0, 40, n).astype(np.int64)
+        vals = rng.integers(-90, 90, n).astype(np.int64)
+        valid = rng.random(n) < 0.8
+        t = Table([Column.from_numpy(part), Column.from_numpy(ok),
+                   Column.from_numpy(vals, validity=valid)])
+        spec = W.WindowSpec(t, [0], [1])
+        df = pd.DataFrame({"p": part, "o": ok,
+                           "v": np.where(valid, vals.astype(float), np.nan)})
+        srt = df.sort_values(["p", "o"], kind="stable")
+        want_max = srt.groupby("p")["v"].cummax().sort_index().to_numpy()
+        want_min = srt.groupby("p")["v"].cummin().sort_index().to_numpy()
+        got_max = np.asarray(W.running_max(spec, 2).data).astype(float)
+        got_min = np.asarray(W.running_min(spec, 2).data).astype(float)
+        np.testing.assert_array_equal(got_max[valid], want_max[valid])
+        np.testing.assert_array_equal(got_min[valid], want_min[valid])
