@@ -27,7 +27,7 @@ from ..column import Column, Table
 from .filter import gather
 from .sort import order_by
 
-_AGGS = ("sum", "count", "min", "max", "mean")
+_AGGS = ("sum", "count", "min", "max", "mean", "var", "std")
 
 
 def _segment_ids(sorted_keys: list[jnp.ndarray],
@@ -38,7 +38,9 @@ def _segment_ids(sorted_keys: list[jnp.ndarray],
     for k, v in zip(sorted_keys, sorted_valid):
         neq = k[1:] != k[:-1]
         if v is not None:
-            neq = neq | (v[1:] != v[:-1])
+            # nulls form ONE group regardless of dead payload bytes (a
+            # mask_table'd column keeps its stale payload under nulls)
+            neq = (neq & v[1:] & v[:-1]) | (v[1:] != v[:-1])
         head = head.at[1:].max(neq.astype(jnp.int32))
     return jnp.cumsum(head, dtype=jnp.int32)
 
@@ -57,6 +59,23 @@ def _agg_segment(data, valid, seg_ids, agg, num_segments, storage_kind):
         cnt = _agg_segment(data, valid, seg_ids, "count", num_segments,
                            storage_kind)
         return s.astype(jnp.float64) / jnp.maximum(cnt, 1).astype(jnp.float64)
+    if agg in ("var", "std"):
+        # sample variance (ddof=1, Spark var_samp/stddev_samp), two-pass:
+        # segment mean first, then squared deviations — the one-pass
+        # sum-of-squares identity cancels catastrophically when the mean
+        # dominates the spread (e.g. values ~1e8 with variance 1)
+        x = data.astype(jnp.float64)
+        x = x if valid is None else jnp.where(valid, x, 0.0)
+        cnt = _agg_segment(data, valid, seg_ids, "count", num_segments,
+                           storage_kind).astype(jnp.float64)
+        mean = (jax.ops.segment_sum(x, seg_ids, num_segments)
+                / jnp.maximum(cnt, 1.0))
+        dev = x - mean[seg_ids]
+        if valid is not None:
+            dev = jnp.where(valid, dev, 0.0)
+        m2 = jax.ops.segment_sum(dev * dev, seg_ids, num_segments)
+        var = m2 / jnp.maximum(cnt - 1.0, 1.0)
+        return jnp.sqrt(var) if agg == "std" else var
     if agg == "min":
         ident = np.inf if storage_kind == "f" else np.iinfo(data.dtype).max
         acc = data if valid is None else jnp.where(valid, data, ident)
@@ -136,12 +155,18 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
             continue
         res = _agg_segment(col.data, col.validity, seg_ids, agg,
                            num_segments, col.dtype.storage.kind)
-        # min/max of an all-null group is null
+        # min/max of an all-null group is null; var/std needs ≥2 valid rows
         if agg in ("min", "max") and col.validity is not None:
             cnt = _agg_segment(col.data, col.validity, seg_ids, "count",
                                num_segments, col.dtype.storage.kind)
             out_cols.append(Column(col.dtype, res.astype(col.dtype.storage),
                                    validity=cnt > 0))
+        elif agg in ("var", "std"):
+            cnt = _agg_segment(col.data, col.validity, seg_ids, "count",
+                               num_segments, col.dtype.storage.kind)
+            dt = _agg_out_dtype(col.dtype, agg)
+            out_cols.append(Column(dt, res.astype(dt.storage),
+                                   validity=cnt >= 2))
         else:
             dt = _agg_out_dtype(col.dtype, agg)
             out_cols.append(Column(dt, res.astype(dt.storage)))
@@ -154,7 +179,7 @@ def _agg_out_dtype(src, agg):
     from .. import types as T
     if agg in ("min", "max"):
         return src
-    if agg == "mean":
+    if agg in ("mean", "var", "std"):
         return T.float64
     if agg == "count":
         return T.int64

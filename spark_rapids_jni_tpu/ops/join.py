@@ -99,13 +99,37 @@ def inner_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:
     return Table(list(lt.columns) + list(rt.columns))
 
 
+def _empty_column(dt) -> Column:
+    from .. import types as T
+    if dt.id == T.TypeId.LIST:
+        return Column(dt, jnp.zeros(0, jnp.uint8), jnp.zeros(1, jnp.int32),
+                      None, [_empty_column(dt.children[0])])
+    if dt.id == T.TypeId.STRUCT:
+        return Column(dt, jnp.zeros(0, jnp.uint8), None, None,
+                      [_empty_column(f) for f in dt.children])
+    if dt.is_variable_width:
+        return Column(dt, jnp.zeros(0, jnp.uint8), jnp.zeros(1, jnp.int32))
+    if dt.id == T.TypeId.DECIMAL128:
+        return Column(dt, jnp.zeros((0, 2), jnp.int64))
+    return Column(dt, jnp.zeros(0, dt.storage))
+
+
 def _null_column(dt, n: int) -> Column:
+    from .. import types as T
+    nulls = jnp.zeros(n, jnp.bool_)
+    if dt.id == T.TypeId.LIST:
+        return Column(dt, jnp.zeros(0, jnp.uint8),
+                      jnp.zeros(n + 1, jnp.int32), nulls,
+                      [_empty_column(dt.children[0])])
+    if dt.id == T.TypeId.STRUCT:
+        return Column(dt, jnp.zeros(0, jnp.uint8), None, nulls,
+                      [_null_column(f, n) for f in dt.children])
     if dt.is_variable_width:
         return Column(dt, jnp.zeros(0, jnp.uint8),
-                      jnp.zeros(n + 1, jnp.int32),
-                      jnp.zeros(n, jnp.bool_))
-    return Column(dt, jnp.zeros(n, dt.storage),
-                  validity=jnp.zeros(n, jnp.bool_))
+                      jnp.zeros(n + 1, jnp.int32), nulls)
+    if dt.id == T.TypeId.DECIMAL128:
+        return Column(dt, jnp.zeros((n, 2), jnp.int64), validity=nulls)
+    return Column(dt, jnp.zeros(n, dt.storage), validity=nulls)
 
 
 def left_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:
@@ -123,6 +147,27 @@ def left_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:
         v = matched if c.validity is None else (c.validity & matched)
         right_cols.append(Column(c.dtype, c.data, c.offsets, v))
     return Table(list(lt.columns) + right_cols)
+
+
+def right_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:
+    """Right outer equi-join; result columns = left ++ right, with null
+    left columns on unmatched right rows."""
+    mirrored = left_join(right, left, right_on, left_on)
+    cols = list(mirrored.columns)            # right ++ left
+    return Table(cols[right.num_columns:] + cols[:right.num_columns])
+
+
+def full_outer_join(left: Table, right: Table, left_on: int,
+                    right_on: int) -> Table:
+    """Full outer equi-join: left-join pairs plus unmatched right rows with
+    null left columns (Spark FULL OUTER)."""
+    from .copying import concat_tables
+    lj = left_join(left, right, left_on, right_on)
+    extra = anti_join(right, left, right_on, left_on)
+    if extra.num_rows == 0:
+        return lj
+    null_left = [_null_column(c.dtype, extra.num_rows) for c in left.columns]
+    return concat_tables([lj, Table(null_left + list(extra.columns))])
 
 
 def semi_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:

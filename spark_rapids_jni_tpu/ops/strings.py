@@ -448,3 +448,117 @@ def to_date(col: Column, fmt: str = "%Y-%m-%d") -> Column:
     days = _days_from_civil(y, msafe, jnp.clip(d, 1, 31)).astype(jnp.int32)
     valid = ok if col.validity is None else (ok & col.validity)
     return Column(T.timestamp_days, days, validity=valid)
+
+
+# ---------------------------------------------------------------------------
+# substring search (cudf strings::contains / find; Spark LIKE)
+# ---------------------------------------------------------------------------
+
+def _match_at(mat: jnp.ndarray, lens: jnp.ndarray, pat: bytes,
+              wildcard: int | None = None) -> jnp.ndarray:
+    """[n, L] bool: does ``pat`` match starting at byte position s?
+
+    Static unrolled compare per pattern byte (m small, L bounded by the
+    byte-matrix width) — each step is one fused VPU compare.  ``wildcard``
+    bytes in the pattern (SQL '_') match anything.  A match must fit inside
+    the row: positions with s + m > len are False.
+    """
+    n, L = mat.shape
+    m = len(pat)
+    s = jnp.arange(L, dtype=jnp.int32)
+    ok = (s[None, :] + m) <= lens[:, None]
+    for k, pb in enumerate(pat):
+        if wildcard is not None and pb == wildcard:
+            continue
+        shifted = mat[:, k:] if k else mat
+        pad = jnp.zeros((n, k), jnp.uint8)
+        cmp = jnp.concatenate([shifted, pad], axis=1) == jnp.uint8(pb)
+        ok = ok & cmp
+    return ok
+
+
+def _as_bool_column(mask: jnp.ndarray, validity) -> Column:
+    return Column(T.bool8, mask.astype(jnp.uint8), validity=validity)
+
+
+def _search_matrix(col: Column, min_width: int):
+    """Byte matrix wide enough for both the column's longest row AND the
+    pattern (``byte_matrix(width=…)`` PINS the width — passing only the
+    pattern length would truncate longer rows and lose matches)."""
+    n = col.num_rows
+    wmax = int(jnp.max(_lengths(col))) if n else 0
+    return byte_matrix(col, width=max(wmax, min_width, 1))
+
+
+def contains(col: Column, pat: str | bytes) -> Column:
+    """True where the row contains ``pat`` (Spark ``contains`` / LIKE
+    '%pat%'); empty pattern matches everything; null rows stay null."""
+    pat = pat.encode() if isinstance(pat, str) else bytes(pat)
+    mat, lens = _search_matrix(col, len(pat))
+    return _as_bool_column(_match_at(mat, lens, pat).any(axis=1),
+                           col.validity)
+
+
+def starts_with(col: Column, pat: str | bytes) -> Column:
+    pat = pat.encode() if isinstance(pat, str) else bytes(pat)
+    mat, lens = _search_matrix(col, len(pat))
+    return _as_bool_column(_match_at(mat, lens, pat)[:, 0], col.validity)
+
+
+def ends_with(col: Column, pat: str | bytes) -> Column:
+    pat = pat.encode() if isinstance(pat, str) else bytes(pat)
+    mat, lens = _search_matrix(col, len(pat))
+    hits = _match_at(mat, lens, pat)
+    pos = jnp.clip(lens - len(pat), 0, mat.shape[1] - 1)
+    at_end = jnp.take_along_axis(hits, pos[:, None].astype(jnp.int32),
+                                 axis=1)[:, 0]
+    return _as_bool_column(at_end & (lens >= len(pat)), col.validity)
+
+
+def like(col: Column, pattern: str) -> Column:
+    """SQL LIKE with ``%`` (any run) and ``_`` (any one byte) — the Spark /
+    cudf ``strings::like`` subset (no escape character support).
+
+    Pieces between ``%`` are matched left to right with a vectorized
+    earliest-match scan per piece; the number of pieces is tiny and static,
+    so the whole predicate stays a short chain of fused compares.
+    """
+    pat = pattern.encode()
+    pieces = pat.split(b"%")
+    anchored_start = not pattern.startswith("%")
+    anchored_end = not pattern.endswith("%")
+    mat, lens = _search_matrix(col, max((len(p) for p in pieces),
+                                        default=0))
+    L = mat.shape[1]
+    n = mat.shape[0]
+    okv = jnp.ones((n,), bool)
+    cur = jnp.zeros((n,), jnp.int32)      # earliest position still usable
+    idx = jnp.arange(L, dtype=jnp.int32)
+    for pi, piece in enumerate(pieces):
+        if not piece:
+            continue
+        hits = _match_at(mat, lens, piece, wildcard=ord("_"))
+        is_first, is_last = pi == 0, pi == len(pieces) - 1
+        if is_first and anchored_start:
+            okv = okv & hits[:, 0]
+            cur = jnp.maximum(cur, len(piece))
+            if is_last and anchored_end:
+                okv = okv & (lens == len(piece))
+            continue
+        if is_last and anchored_end:
+            pos = jnp.clip(lens - len(piece), 0, L - 1)
+            at_end = jnp.take_along_axis(
+                hits, pos[:, None].astype(jnp.int32), axis=1)[:, 0]
+            okv = okv & at_end & (lens >= len(piece)) & (pos >= cur)
+            continue
+        # floating piece: earliest match at position >= cur
+        usable = hits & (idx[None, :] >= cur[:, None])
+        found = usable.any(axis=1)
+        first = jnp.argmax(usable, axis=1).astype(jnp.int32)
+        okv = okv & found
+        cur = first + len(piece)
+    if not any(pieces):
+        # pattern is all-% (or empty): "%...%" matches everything,
+        # "" matches only the empty string
+        okv = jnp.ones((n,), bool) if b"%" in pat else (lens == 0)
+    return _as_bool_column(okv, col.validity)

@@ -60,7 +60,10 @@ class WindowSpec:
             v = col.validity
             if v is not None:
                 sv = v[self.order]
-                neq = neq | (sv[1:] != sv[:-1])
+                # a validity flip is a boundary; two NULLs are the SAME
+                # key regardless of their dead payload bytes
+                neq = ((neq & sv[1:] & sv[:-1])
+                       | (sv[1:] != sv[:-1]))
             head = head.at[1:].max(neq)
         self.head = head
         self.seg_id = jnp.cumsum(head.astype(jnp.int32)) - 1
@@ -110,10 +113,11 @@ def _order_change(spec: WindowSpec, order_keys: Sequence[int]) -> jnp.ndarray:
         else:
             neq = k[1:] != k[:-1]
         if col.validity is not None:
-            # NULL is its own rank value (Spark: null sorts distinctly) —
-            # a validity flip between adjacent rows is an order change
+            # NULL is its own rank value (Spark: null sorts distinctly),
+            # but all NULLs TIE with each other — mask payload noise where
+            # both neighbors are null, flag where validity flips
             sv = col.validity[spec.order]
-            neq = neq | (sv[1:] != sv[:-1])
+            neq = (neq & sv[1:] & sv[:-1]) | (sv[1:] != sv[:-1])
         change = change.at[1:].max(neq)
     return change
 

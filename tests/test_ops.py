@@ -238,3 +238,91 @@ def test_sort_negative_zero_equal():
                          ascending=[asc])[0].to_numpy()
         expect = np.sort(vals) if asc else np.sort(vals)[::-1]
         np.testing.assert_array_equal(np.sign(got) + got, np.sign(expect) + expect)
+
+
+class TestOuterJoins:
+    def _tables(self):
+        left = Table([Column.from_numpy(np.asarray([1, 2, 3, 2], np.int64)),
+                      Column.strings_from_list(["a", "b", "c", "d"])])
+        right = Table([Column.from_numpy(np.asarray([2, 4], np.int64)),
+                       Column.from_numpy(np.asarray([20, 40], np.int32))])
+        return left, right
+
+    def test_full_outer_matches_pandas(self):
+        import pandas as pd
+        left, right = self._tables()
+        out = ops.full_outer_join(left, right, 0, 2 - 2)
+        ldf = pd.DataFrame({"k": [1, 2, 3, 2], "s": ["a", "b", "c", "d"]})
+        rdf = pd.DataFrame({"k2": [2, 4], "v": [20, 40]})
+        exp = ldf.merge(rdf, left_on="k", right_on="k2", how="outer")
+        assert out.num_rows == len(exp)
+        got = sorted(zip(out[0].to_pylist(), out[1].to_pylist(),
+                         out[2].to_pylist(), out[3].to_pylist()),
+                     key=lambda r: (r[0] is None, r[0], r[3] or 0))
+        want = sorted(
+            [(None if pd.isna(r.k) else int(r.k),
+              None if pd.isna(r.s) else r.s,
+              None if pd.isna(r.k2) else int(r.k2),
+              None if pd.isna(r.v) else int(r.v))
+             for r in exp.itertuples()],
+            key=lambda r: (r[0] is None, r[0] if r[0] is not None else 0,
+                           r[3] or 0))
+        assert sorted(map(repr, got)) == sorted(map(repr, want))
+
+    def test_right_join(self):
+        left, right = self._tables()
+        out = ops.right_join(left, right, 0, 0)
+        # rows: key2 matched twice (b, d), key4 unmatched
+        rows = set(zip(out[0].to_pylist(), out[1].to_pylist(),
+                       out[2].to_pylist(), out[3].to_pylist()))
+        assert rows == {(2, "b", 2, 20), (2, "d", 2, 20),
+                        (None, None, 4, 40)}
+
+    def test_full_outer_all_matched_is_left_join(self):
+        left = Table([Column.from_numpy(np.asarray([1, 2], np.int64))])
+        right = Table([Column.from_numpy(np.asarray([1, 2], np.int64))])
+        out = ops.full_outer_join(left, right, 0, 0)
+        assert out.num_rows == 2
+
+
+class TestGroupbyVarStd:
+    def test_var_std_match_pandas(self):
+        import pandas as pd
+        rng = np.random.default_rng(5)
+        keys = rng.integers(0, 5, 200).astype(np.int32)
+        vals = rng.standard_normal(200)
+        valid = rng.random(200) < 0.8
+        t = Table([Column.from_numpy(keys),
+                   Column.from_numpy(vals, validity=valid)])
+        out = ops.groupby_aggregate(t, [0], [(1, "var"), (1, "std")])
+        df = pd.DataFrame({"k": keys, "v": np.where(valid, vals, np.nan)})
+        exp = df.groupby("k")["v"].agg(["var", "std"]).reset_index()
+        np.testing.assert_allclose(np.asarray(out[1].data),
+                                   exp["var"].to_numpy(), rtol=1e-9)
+        np.testing.assert_allclose(np.asarray(out[2].data),
+                                   exp["std"].to_numpy(), rtol=1e-9)
+
+    def test_var_single_row_group_is_null(self):
+        t = Table([Column.from_numpy(np.asarray([1, 2, 2], np.int32)),
+                   Column.from_numpy(np.asarray([5.0, 1.0, 3.0]))])
+        out = ops.groupby_aggregate(t, [0], [(1, "var")])
+        assert out[1].to_pylist() == [None, 2.0]
+
+
+class TestGroupbyNullKeys:
+    def test_masked_rows_form_one_null_group(self):
+        # mask_table keeps stale payloads under nulls: they must still
+        # collapse into ONE null group (Spark GROUP BY null semantics)
+        t = Table([Column.from_numpy(np.asarray([5, 7, 1], np.int64)),
+                   Column.from_numpy(np.asarray([10, 20, 30], np.int64))])
+        masked = ops.mask_table(t, jnp.asarray([False, False, True]))
+        out = ops.groupby_aggregate(masked, [0], [(1, "count")])
+        assert out.num_rows == 2   # {null, 1}
+
+    def test_var_numerically_stable(self):
+        # mean >> spread: the naive sum-of-squares identity returns 0.0
+        vals = np.asarray([1e8, 1e8 + 1, 1e8 + 2], np.float64)
+        t = Table([Column.from_numpy(np.ones(3, np.int32)),
+                   Column.from_numpy(vals)])
+        out = ops.groupby_aggregate(t, [0], [(1, "var")])
+        np.testing.assert_allclose(np.asarray(out[1].data), [1.0], rtol=1e-9)

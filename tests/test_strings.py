@@ -230,3 +230,49 @@ def test_strings_roundtrip_through_rowconv():
     batches = sr.convert_to_rows(t)
     back = sr.convert_from_rows(batches[0], t.schema)
     assert back[0].to_pylist() == vals
+
+
+class TestSearch:
+    """contains/starts_with/ends_with/like vs a Python oracle."""
+
+    def _col_and_vals(self, seed=0, n=300):
+        import random
+        rng = random.Random(seed)
+        alphabet = "abcx_%"
+        vals = [None if rng.random() < 0.1 else
+                "".join(rng.choice(alphabet) for _ in range(rng.randrange(12)))
+                for _ in range(n)]
+        return Column.strings_from_list(vals), vals
+
+    def test_contains(self):
+        col, vals = self._col_and_vals()
+        for pat in ["a", "ab", "abc", "xx", ""]:
+            got = S.contains(col, pat).to_pylist()
+            want = [None if v is None else (pat in v) for v in vals]
+            assert got == want, pat
+
+    def test_starts_ends(self):
+        col, vals = self._col_and_vals(1)
+        for pat in ["a", "ba", "ccc", ""]:
+            assert (S.starts_with(col, pat).to_pylist()
+                    == [None if v is None else v.startswith(pat)
+                        for v in vals]), pat
+            assert (S.ends_with(col, pat).to_pylist()
+                    == [None if v is None else v.endswith(pat)
+                        for v in vals]), pat
+
+    def test_like_matches_python_regex(self):
+        import re
+        col, vals = self._col_and_vals(2)
+        pats = ["a%", "%a", "%ab%", "a_c", "_", "%a%b%", "abc", "%", "",
+                "a%b%c", "__%"]
+        for pat in pats:
+            rx = re.compile(
+                "^" + "".join(".*" if ch == "%" else "." if ch == "_"
+                              else re.escape(ch) for ch in pat) + "$",
+                re.DOTALL)
+            got = S.like(col, pat).to_pylist()
+            want = [None if v is None else bool(rx.match(v)) for v in vals]
+            assert got == want, (pat,
+                                 [(v, g, w) for v, g, w in
+                                  zip(vals, got, want) if g != w][:5])

@@ -132,3 +132,20 @@ class TestReviewRegressions:
         got_min = np.asarray(W.running_min(spec, 2).data).astype(float)
         np.testing.assert_array_equal(got_max[valid], want_max[valid])
         np.testing.assert_array_equal(got_min[valid], want_min[valid])
+
+    def test_null_partition_keys_form_one_partition(self):
+        # both rows NULL with DIFFERENT dead payloads: one Spark partition
+        part = Column.from_numpy(np.asarray([5, 7], np.int32),
+                                 validity=np.asarray([False, False]))
+        ok = Column.from_numpy(np.asarray([1, 2], np.int64))
+        t = Table([part, ok])
+        spec = W.WindowSpec(t, [0], [1])
+        assert np.asarray(W.row_number(spec).data).tolist() == [1, 2]
+
+    def test_null_order_keys_tie_despite_payloads(self):
+        part = Column.from_numpy(np.zeros(2, np.int32))
+        ok = Column.from_numpy(np.asarray([3, 9], np.int64),
+                               validity=np.asarray([False, False]))
+        spec = W.WindowSpec(Table([part, ok]), [0], [1])
+        assert np.asarray(W.rank(spec, [1]).data).tolist() == [1, 1]
+        assert np.asarray(W.dense_rank(spec, [1]).data).tolist() == [1, 1]
