@@ -25,7 +25,7 @@ def cast(col: Column, to: T.DType) -> Column:
     if src == to:
         return col
     if src.id == T.TypeId.STRING or to.id == T.TypeId.STRING:
-        raise NotImplementedError("string casts live in ops.strings")
+        return _cast_string(col, to)
 
     if src.id == T.TypeId.DECIMAL128 or to.id == T.TypeId.DECIMAL128:
         return _cast_decimal128(col, to)
@@ -52,6 +52,55 @@ def cast(col: Column, to: T.DType) -> Column:
     else:
         data = data.astype(to.storage)
     return Column(to, data, validity=col.validity)
+
+
+def _cast_string(col: Column, to: T.DType) -> Column:
+    """STRING ↔ numeric casts, dispatching to the ops.strings parse/format
+    kernels (Spark CAST semantics: unparseable rows become null)."""
+    from . import strings as S
+    src = col.dtype
+    if src.id == T.TypeId.STRING:
+        if to.id == T.TypeId.BOOL8:
+            return S.to_bool(col)
+        if to.id == T.TypeId.DECIMAL64 or to.id == T.TypeId.DECIMAL32:
+            parsed = S.to_decimal(col, to.scale)
+            if to.id == T.TypeId.DECIMAL64:
+                return parsed
+            # narrow with overflow → null (Spark CAST), not int32 wrap
+            i32 = np.iinfo(np.int32)
+            in_range = (parsed.data >= i32.min) & (parsed.data <= i32.max)
+            v = (in_range if parsed.validity is None
+                 else (parsed.validity & in_range))
+            return Column(to, parsed.data.astype(to.storage), validity=v)
+        if to.id == T.TypeId.TIMESTAMP_DAYS:
+            return S.to_date(col)
+        if to.is_timestamp or (T.TypeId.DURATION_DAYS <= to.id
+                               <= T.TypeId.DURATION_NANOSECONDS):
+            raise NotImplementedError(f"STRING → {to.id.name}")
+        if to.is_fixed_width and to.storage.kind in "iu":
+            parsed = S.to_int64(col)
+            if to == T.int64:
+                return parsed
+            info = np.iinfo(to.storage)
+            in_range = ((parsed.data >= info.min)
+                        & (parsed.data <= info.max))
+            v = (in_range if parsed.validity is None
+                 else (parsed.validity & in_range))
+            return Column(to, parsed.data.astype(to.storage), validity=v)
+        raise NotImplementedError(f"STRING → {to.id.name}")
+    # numeric → STRING
+    if src.id == T.TypeId.BOOL8:
+        return S.format_bool(col)
+    if src.id == T.TypeId.TIMESTAMP_DAYS:
+        return S.format_date(col)
+    if src.is_timestamp or (T.TypeId.DURATION_DAYS <= src.id
+                            <= T.TypeId.DURATION_NANOSECONDS):
+        raise NotImplementedError(f"{src.id.name} → STRING")
+    if src.is_decimal and src.id != T.TypeId.DECIMAL128:
+        return S.format_decimal(col)
+    if src.is_fixed_width and src.storage.kind in "iu":
+        return S.format_int64(col)
+    raise NotImplementedError(f"{src.id.name} → STRING")
 
 
 def _cast_decimal128(col: Column, to: T.DType) -> Column:

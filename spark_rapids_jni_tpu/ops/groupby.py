@@ -40,7 +40,7 @@ def _segment_ids(sorted_keys: list[jnp.ndarray],
         if v is not None:
             # nulls form ONE group regardless of dead payload bytes (a
             # mask_table'd column keeps its stale payload under nulls)
-            neq = (neq & v[1:] & v[:-1]) | (v[1:] != v[:-1])
+            neq = neq_with_null_merge(neq, v[1:], v[:-1])
         head = head.at[1:].max(neq.astype(jnp.int32))
     return jnp.cumsum(head, dtype=jnp.int32)
 
@@ -59,23 +59,6 @@ def _agg_segment(data, valid, seg_ids, agg, num_segments, storage_kind):
         cnt = _agg_segment(data, valid, seg_ids, "count", num_segments,
                            storage_kind)
         return s.astype(jnp.float64) / jnp.maximum(cnt, 1).astype(jnp.float64)
-    if agg in ("var", "std"):
-        # sample variance (ddof=1, Spark var_samp/stddev_samp), two-pass:
-        # segment mean first, then squared deviations — the one-pass
-        # sum-of-squares identity cancels catastrophically when the mean
-        # dominates the spread (e.g. values ~1e8 with variance 1)
-        x = data.astype(jnp.float64)
-        x = x if valid is None else jnp.where(valid, x, 0.0)
-        cnt = _agg_segment(data, valid, seg_ids, "count", num_segments,
-                           storage_kind).astype(jnp.float64)
-        mean = (jax.ops.segment_sum(x, seg_ids, num_segments)
-                / jnp.maximum(cnt, 1.0))
-        dev = x - mean[seg_ids]
-        if valid is not None:
-            dev = jnp.where(valid, dev, 0.0)
-        m2 = jax.ops.segment_sum(dev * dev, seg_ids, num_segments)
-        var = m2 / jnp.maximum(cnt - 1.0, 1.0)
-        return jnp.sqrt(var) if agg == "std" else var
     if agg == "min":
         ident = np.inf if storage_kind == "f" else np.iinfo(data.dtype).max
         acc = data if valid is None else jnp.where(valid, data, ident)
@@ -85,6 +68,32 @@ def _agg_segment(data, valid, seg_ids, agg, num_segments, storage_kind):
         acc = data if valid is None else jnp.where(valid, data, ident)
         return jax.ops.segment_max(acc, seg_ids, num_segments)
     raise ValueError(f"unknown aggregation {agg!r} (supported: {_AGGS})")
+
+
+def _var_segment(x, valid, seg_ids, num_segments, cnt, std: bool):
+    """Sample variance/stddev (ddof=1, Spark var_samp/stddev_samp), two-pass:
+    segment mean first, then squared deviations — the one-pass
+    sum-of-squares identity cancels catastrophically when the mean
+    dominates the spread (e.g. values ~1e8 with variance 1)."""
+    x = x.astype(jnp.float64)
+    x = x if valid is None else jnp.where(valid, x, 0.0)
+    cntf = cnt.astype(jnp.float64)
+    mean = (jax.ops.segment_sum(x, seg_ids, num_segments)
+            / jnp.maximum(cntf, 1.0))
+    dev = x - mean[seg_ids]
+    if valid is not None:
+        dev = jnp.where(valid, dev, 0.0)
+    m2 = jax.ops.segment_sum(dev * dev, seg_ids, num_segments)
+    var = m2 / jnp.maximum(cntf - 1.0, 1.0)
+    return jnp.sqrt(var) if std else var
+
+
+def neq_with_null_merge(neq, v1, v0):
+    """Adjacent-key inequality honoring nulls-form-one-group: a validity
+    flip is a boundary, and two null neighbors are EQUAL regardless of
+    their dead payload bytes (shared by groupby segments, window
+    partitions, and rank order keys)."""
+    return (neq & v1 & v0) | (v1 != v0)
 
 
 def groupby_aggregate(table: Table, key_indices: Sequence[int],
@@ -153,20 +162,30 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
             from . import decimal128 as d128
             out_cols.append(d128.segmented_sum(col, seg_ids, num_segments))
             continue
-        res = _agg_segment(col.data, col.validity, seg_ids, agg,
-                           num_segments, col.dtype.storage.kind)
-        # min/max of an all-null group is null; var/std needs ≥2 valid rows
+        data = col.data
+        if col.dtype.is_decimal and agg in ("mean", "var", "std"):
+            # value-domain statistics: apply the decimal scale (the raw
+            # payload is unscaled — var over cents would be off by 10^-2s)
+            data = data.astype(jnp.float64) * np.float64(10.0) ** col.dtype.scale
+        if agg in ("var", "std"):
+            cnt = _agg_segment(data, col.validity, seg_ids, "count",
+                               num_segments, "i")
+            res = _var_segment(data, col.validity, seg_ids, num_segments,
+                               cnt, std=(agg == "std"))
+            dt = _agg_out_dtype(col.dtype, agg)
+            out_cols.append(Column(dt, res.astype(dt.storage),
+                                   validity=cnt >= 2))
+            continue
+        res = _agg_segment(data, col.validity, seg_ids, agg,
+                           num_segments,
+                           "f" if (col.dtype.is_decimal and agg == "mean")
+                           else col.dtype.storage.kind)
+        # min/max of an all-null group is null
         if agg in ("min", "max") and col.validity is not None:
             cnt = _agg_segment(col.data, col.validity, seg_ids, "count",
                                num_segments, col.dtype.storage.kind)
             out_cols.append(Column(col.dtype, res.astype(col.dtype.storage),
                                    validity=cnt > 0))
-        elif agg in ("var", "std"):
-            cnt = _agg_segment(col.data, col.validity, seg_ids, "count",
-                               num_segments, col.dtype.storage.kind)
-            dt = _agg_out_dtype(col.dtype, agg)
-            out_cols.append(Column(dt, res.astype(dt.storage),
-                                   validity=cnt >= 2))
         else:
             dt = _agg_out_dtype(col.dtype, agg)
             out_cols.append(Column(dt, res.astype(dt.storage)))

@@ -562,3 +562,186 @@ def like(col: Column, pattern: str) -> Column:
         # "" matches only the empty string
         okv = jnp.ones((n,), bool) if b"%" in pat else (lens == 0)
     return _as_bool_column(okv, col.validity)
+
+
+# ---------------------------------------------------------------------------
+# numeric → string formatting (cudf strings::from_integers / from_fixed_point;
+# Spark CAST(x AS STRING))
+# ---------------------------------------------------------------------------
+
+_POW10 = [10 ** k for k in range(19)]
+
+
+def _digit_matrix(mag: jnp.ndarray, width: int) -> jnp.ndarray:
+    """uint8 [n, width] ASCII digits of ``mag`` (int64 ≥ 0), right-aligned
+    at column width-1 — one fused divide/mod per digit position."""
+    cols = []
+    for p in range(width):
+        div = 10 ** (width - 1 - p)
+        cols.append(((mag // div) % 10).astype(jnp.uint8) + ord("0"))
+    return jnp.stack(cols, axis=1)
+
+
+def _ndigits(mag: jnp.ndarray) -> jnp.ndarray:
+    """Decimal digit count of int64 mag ≥ 0 (0 → 1 digit)."""
+    n = jnp.ones_like(mag, dtype=jnp.int32)
+    for k in range(1, 19):
+        n = n + (mag >= _POW10[k]).astype(jnp.int32)
+    return n
+
+
+def _matrix_to_strings(mat: jnp.ndarray, starts: jnp.ndarray,
+                       lens: jnp.ndarray, validity) -> Column:
+    """Assemble a STRING column from per-row [start, start+len) slices of a
+    byte matrix (same two-phase gather as ``substring``)."""
+    lens = jnp.where(validity, lens, 0) if validity is not None else lens
+    new_offs = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+    total = int(new_offs[-1])                 # scalar sync (chars total)
+    if total == 0:
+        return Column(T.string, jnp.zeros(0, jnp.uint8), new_offs, validity)
+    row_of = _segment_of(new_offs, total)
+    within = jnp.arange(total, dtype=jnp.int32) - new_offs[row_of]
+    chars = mat[row_of, starts[row_of] + within]
+    return Column(T.string, chars, new_offs, validity)
+
+
+def format_int64(col: Column) -> Column:
+    """Integer column → decimal strings (Spark CAST(x AS STRING)).
+
+    INT64_MIN is one past abs()'s range; it is handled by formatting
+    magnitude-minus-one digits… practically: values are first widened to
+    int64; -2^63 formats via the unsigned magnitude trick below.
+    """
+    v = col.data.astype(jnp.int64)
+    neg = v < 0
+    big = v == jnp.int64(-(2 ** 63))   # |INT64_MIN| overflows abs()
+    mag = jnp.where(big, 0, jnp.abs(v))
+    nd = _ndigits(mag)
+    W = 20  # '-' + 19 digits
+    digits = _digit_matrix(mag, W - 1)
+    mat = jnp.concatenate([jnp.full((v.shape[0], 1), ord("-"), jnp.uint8),
+                           digits], axis=1)
+    lens = nd + neg.astype(jnp.int32)
+    starts = jnp.where(neg, (W - 1) - nd, W - nd)
+    # '-' sits immediately before the first digit: copy it there
+    rows = jnp.arange(v.shape[0])
+    spos = jnp.maximum(starts, 0)
+    mat = mat.at[rows, spos].set(
+        jnp.where(neg, jnp.uint8(ord("-")), mat[rows, spos]))
+    # INT64_MIN: overwrite with the literal (its magnitude has no int64 rep)
+    lit = jnp.asarray(np.frombuffer(b"-9223372036854775808", np.uint8))
+    mat = jnp.where(big[:, None], lit[None, :], mat)
+    starts = jnp.where(big, 0, starts)
+    lens = jnp.where(big, W, lens)
+    return _matrix_to_strings(mat, starts, lens, col.validity)
+
+
+def format_decimal(col: Column) -> Column:
+    """decimal32/64 column → strings with the scale's fractional digits
+    ("123.45" for unscaled 12345 at scale -2); scale 0 formats as integers."""
+    if col.dtype.scale == 0:
+        return format_int64(col)
+    if col.dtype.scale > 0:
+        # positive scale: value = unscaled * 10^s — format the full integer
+        mul = 10 ** col.dtype.scale
+        return format_int64(Column(T.int64, col.data.astype(jnp.int64) * mul,
+                                   validity=col.validity))
+    k = -col.dtype.scale
+    v = col.data.astype(jnp.int64)
+    neg = v < 0
+    mag = jnp.abs(v)
+    int_part = mag // (10 ** k)
+    frac = mag % (10 ** k)
+    nd_int = _ndigits(int_part)
+    WI = 19
+    int_digits = _digit_matrix(int_part, WI)
+    frac_digits = _digit_matrix(frac, k)
+    dot = jnp.full((v.shape[0], 1), ord("."), jnp.uint8)
+    sign = jnp.full((v.shape[0], 1), ord("-"), jnp.uint8)
+    mat = jnp.concatenate([sign, int_digits, dot, frac_digits], axis=1)
+    # layout inside mat: [0]='-', [1..WI]=int digits right-aligned,
+    # [WI+1]='.', [WI+2..]=frac.  The string starts at the sign (if neg)
+    # else at the first significant int digit.
+    first_digit = 1 + WI - nd_int
+    starts = jnp.where(neg, first_digit - 1, first_digit)
+    mat = mat.at[jnp.arange(v.shape[0]), jnp.maximum(starts, 0)].set(
+        jnp.where(neg, jnp.uint8(ord("-")),
+                  mat[jnp.arange(v.shape[0]), jnp.maximum(starts, 0)]))
+    lens = nd_int + 1 + k + neg.astype(jnp.int32)
+    return _matrix_to_strings(mat, starts, lens, col.validity)
+
+
+def _civil_from_days(days: jnp.ndarray):
+    """days since 1970-01-01 → (y, m, d), Hinnant's civil_from_days with
+    floor-division vector math (the inverse of ``_days_from_civil``)."""
+    z = days.astype(jnp.int64) + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def format_date(col: Column) -> Column:
+    """TIMESTAMP_DAYS → ISO "YYYY-MM-DD" strings (Spark CAST(date AS
+    STRING)); years outside 0000-9999 are null (no expanded-year format)."""
+    y, m, d = _civil_from_days(col.data)
+    ok = (y >= 0) & (y <= 9999)
+    ys = jnp.clip(y, 0, 9999)
+    mat = jnp.concatenate([
+        _digit_matrix(ys, 4),
+        jnp.full((col.num_rows, 1), ord("-"), jnp.uint8),
+        _digit_matrix(m, 2),
+        jnp.full((col.num_rows, 1), ord("-"), jnp.uint8),
+        _digit_matrix(d, 2),
+    ], axis=1)
+    valid = ok if col.validity is None else (ok & col.validity)
+    starts = jnp.zeros(col.num_rows, jnp.int32)
+    lens = jnp.full(col.num_rows, 10, jnp.int32)
+    return _matrix_to_strings(mat, starts, lens, valid)
+
+
+_TRUE_WORDS = (b"true", b"t", b"yes", b"y", b"1")
+_FALSE_WORDS = (b"false", b"f", b"no", b"n", b"0")
+
+
+def to_bool(col: Column) -> Column:
+    """Spark CAST(string AS BOOLEAN): case-insensitive true/false/t/f/
+    yes/no/y/n/1/0; anything else (after trimming) is null."""
+    low = lower(col)
+    mat, lens = _search_matrix(low, 5)
+    mat, lens = _trimmed(mat, lens)
+
+    def word_eq(word: bytes):
+        m = jnp.asarray(lens == len(word))
+        for k, b in enumerate(word):
+            m = m & (mat[:, k] == b)
+        return m
+
+    is_true = jnp.zeros(col.num_rows, bool)
+    is_false = jnp.zeros(col.num_rows, bool)
+    for w in _TRUE_WORDS:
+        is_true = is_true | word_eq(w)
+    for w in _FALSE_WORDS:
+        is_false = is_false | word_eq(w)
+    ok = is_true | is_false
+    valid = ok if col.validity is None else (ok & col.validity)
+    return Column(T.bool8, is_true.astype(jnp.uint8), validity=valid)
+
+
+def format_bool(col: Column) -> Column:
+    """BOOL8 → "true"/"false" strings (Spark CAST(boolean AS STRING))."""
+    b = col.data != 0
+    lit = jnp.asarray(np.frombuffer(b"falsetrue\x00", np.uint8))
+    # one 5-wide matrix per row: "false" or "true\0"
+    mat5 = jnp.where(b[:, None], lit[None, 5:10],
+                     jnp.broadcast_to(lit[None, :5], (col.num_rows, 5)))
+    lens = jnp.where(b, 4, 5).astype(jnp.int32)
+    starts = jnp.zeros(col.num_rows, jnp.int32)
+    return _matrix_to_strings(mat5, starts, lens, col.validity)

@@ -20,6 +20,7 @@ import numpy as np
 
 from .. import types as T
 from ..column import Column, Table
+from .groupby import neq_with_null_merge
 from .scan import jax_cummax
 from .sort import order_by
 
@@ -60,10 +61,7 @@ class WindowSpec:
             v = col.validity
             if v is not None:
                 sv = v[self.order]
-                # a validity flip is a boundary; two NULLs are the SAME
-                # key regardless of their dead payload bytes
-                neq = ((neq & sv[1:] & sv[:-1])
-                       | (sv[1:] != sv[:-1]))
+                neq = neq_with_null_merge(neq, sv[1:], sv[:-1])
             head = head.at[1:].max(neq)
         self.head = head
         self.seg_id = jnp.cumsum(head.astype(jnp.int32)) - 1
@@ -114,10 +112,9 @@ def _order_change(spec: WindowSpec, order_keys: Sequence[int]) -> jnp.ndarray:
             neq = k[1:] != k[:-1]
         if col.validity is not None:
             # NULL is its own rank value (Spark: null sorts distinctly),
-            # but all NULLs TIE with each other — mask payload noise where
-            # both neighbors are null, flag where validity flips
+            # but all NULLs TIE with each other
             sv = col.validity[spec.order]
-            neq = (neq & sv[1:] & sv[:-1]) | (sv[1:] != sv[:-1])
+            neq = neq_with_null_merge(neq, sv[1:], sv[:-1])
         change = change.at[1:].max(neq)
     return change
 

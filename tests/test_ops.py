@@ -326,3 +326,14 @@ class TestGroupbyNullKeys:
                    Column.from_numpy(vals)])
         out = ops.groupby_aggregate(t, [0], [(1, "var")])
         np.testing.assert_allclose(np.asarray(out[1].data), [1.0], rtol=1e-9)
+
+
+class TestDecimalStatistics:
+    def test_groupby_var_mean_decimal_scaled(self):
+        # var/mean over decimal64(-2) must be in VALUE domain, not cents
+        t = Table([Column.from_numpy(np.ones(2, np.int32)),
+                   Column.from_numpy(np.asarray([100, 300], np.int64),
+                                     sr.decimal64(-2))])
+        out = ops.groupby_aggregate(t, [0], [(1, "var"), (1, "mean")])
+        np.testing.assert_allclose(np.asarray(out[1].data), [2.0])
+        np.testing.assert_allclose(np.asarray(out[2].data), [2.0])

@@ -10,7 +10,7 @@ import pandas as pd
 import pytest
 
 import spark_rapids_jni_tpu as sr
-from spark_rapids_jni_tpu import Column, Table
+from spark_rapids_jni_tpu import Column, Table, types as T
 from spark_rapids_jni_tpu.ops import strings as S
 from spark_rapids_jni_tpu.ops import (groupby_aggregate, inner_join,
                                       left_join, order_by, sort_table)
@@ -276,3 +276,92 @@ class TestSearch:
             assert got == want, (pat,
                                  [(v, g, w) for v, g, w in
                                   zip(vals, got, want) if g != w][:5])
+
+
+class TestFormat:
+    def test_format_int64_edges(self):
+        vals = [0, 7, -7, 123456, -(2**63), 2**63 - 1, -1, 10**18, None]
+        c = Column.from_numpy(
+            np.asarray([0 if v is None else v for v in vals], np.int64),
+            validity=np.asarray([v is not None for v in vals]))
+        assert S.format_int64(c).to_pylist() == \
+            [None if v is None else str(v) for v in vals]
+
+    def test_format_int64_random_vs_python(self):
+        rng = np.random.default_rng(0)
+        v = rng.integers(-10**17, 10**17, 3000)
+        got = S.format_int64(Column.from_numpy(v)).to_pylist()
+        assert got == [str(x) for x in v.tolist()]
+
+    def test_format_decimal(self):
+        c = Column.from_numpy(np.asarray([12345, -5, 0, -12000], np.int64),
+                              T.decimal64(-2))
+        assert S.format_decimal(c).to_pylist() == \
+            ["123.45", "-0.05", "0.00", "-120.00"]
+        c2 = Column.from_numpy(np.asarray([45], np.int32), T.decimal32(2))
+        assert S.format_decimal(c2).to_pylist() == ["4500"]
+
+    def test_cast_string_roundtrip(self):
+        from spark_rapids_jni_tpu.ops import cast
+        vals = ["12345", "-7", None, "junk"]
+        parsed = cast(Column.strings_from_list(vals), T.int64)
+        assert parsed.to_pylist() == [12345, -7, None, None]
+        back = cast(parsed, T.string)
+        assert back.to_pylist() == ["12345", "-7", None, None]
+        dec = cast(Column.strings_from_list(["1.25", "-3.5"]),
+                   T.decimal64(-2))
+        assert cast(dec, T.string).to_pylist() == ["1.25", "-3.50"]
+
+    def test_cast_string_to_int32(self):
+        from spark_rapids_jni_tpu.ops import cast
+        out = cast(Column.strings_from_list(["42", "-1"]), T.int32)
+        assert out.dtype == T.int32
+        assert out.to_pylist() == [42, -1]
+
+    def test_cast_string_to_date(self):
+        from spark_rapids_jni_tpu.ops import cast
+        out = cast(Column.strings_from_list(["1970-01-02", "bad"]),
+                   T.timestamp_days)
+        assert out.to_pylist() == [1, None]
+
+
+class TestCastStringEdges:
+    def test_bool_roundtrip(self):
+        from spark_rapids_jni_tpu.ops import cast
+        c = Column.strings_from_list(["true", "FALSE", " yes ", "0", "x",
+                                      None])
+        b = cast(c, T.bool8)
+        assert b.to_pylist() == [True, False, True, False, None, None]
+        back = cast(b, T.string)
+        assert back.to_pylist() == ["true", "false", "true", "false",
+                                    None, None]
+
+    def test_date_roundtrip(self):
+        from spark_rapids_jni_tpu.ops import cast
+        days = np.asarray([0, 18321, -1, 2932896], np.int32)  # 9999-12-31
+        d = Column.from_numpy(days, T.timestamp_days)
+        s = cast(d, T.string)
+        assert s.to_pylist() == ["1970-01-01", "2020-02-29", "1969-12-31",
+                                 "9999-12-31"]
+        back = cast(s, T.timestamp_days)
+        np.testing.assert_array_equal(np.asarray(back.data), days)
+
+    def test_string_to_narrow_int_overflow_null(self):
+        from spark_rapids_jni_tpu.ops import cast
+        c = Column.strings_from_list(["300", "42", "-129", "127"])
+        out = cast(c, T.int8)
+        assert out.to_pylist() == [None, 42, None, 127]
+
+    def test_string_to_decimal32_overflow_null(self):
+        from spark_rapids_jni_tpu.ops import cast
+        c = Column.strings_from_list(["9999999999", "12.5"])
+        out = cast(c, T.decimal32(-1))
+        assert out.to_pylist() == [None, 125]
+
+    def test_timestamp_us_to_string_rejected(self):
+        from spark_rapids_jni_tpu.ops import cast
+        c = Column.from_numpy(np.asarray([0], np.int64), T.timestamp_us)
+        with pytest.raises(NotImplementedError):
+            cast(c, T.string)
+        with pytest.raises(NotImplementedError):
+            cast(Column.strings_from_list(["1"]), T.timestamp_us)
