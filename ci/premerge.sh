@@ -15,6 +15,17 @@ make -C spark_rapids_jni_tpu/native -s -j"$(nproc)"
 echo "== build provenance =="
 python ci/build_info.py
 
+echo "== wheel packaging (jar-with-embedded-.so analog) =="
+python -m pip wheel . --no-deps --no-build-isolation -q -w target/dist
+python - <<'PYEOF'
+import glob, zipfile
+w = sorted(glob.glob("target/dist/*.whl"))[-1]
+names = zipfile.ZipFile(w).namelist()
+for so in ("native/libsrjt.so", "native/libsrjt_parquet.so"):
+    assert any(n.endswith(so) for n in names), f"{so} missing from wheel"
+print(f"wheel OK: {w}")
+PYEOF
+
 if command -v javac >/dev/null 2>&1; then
     echo "== java tier =="
     mkdir -p target/java-classes

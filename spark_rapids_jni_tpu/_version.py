@@ -5,4 +5,4 @@ stamp exists) and read by ``ci/build_info.py`` when stamping — keeping the
 two from drifting.
 """
 
-BASE_VERSION = "0.2.0-dev"
+BASE_VERSION = "0.2.0.dev0"

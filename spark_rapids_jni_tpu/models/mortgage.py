@@ -87,12 +87,10 @@ def etl(files: dict[str, bytes]) -> Table:
     # columns: loan_id, max_delinq, mean_upb_cents, count, min_period
 
     joined = inner_join(acq, agg, 0, 0)
-    # acq(6) ++ agg(5): drop the duplicate right-side loan_id
+    # acq(6) ++ agg(5): drop the duplicate right-side loan_id.  The mean
+    # over the decimal64(-2) UPB column is already value-domain dollars
+    # (groupby applies the decimal scale to mean/var/std).
     feats = [joined[i] for i in range(6)] + [joined[i] for i in range(7, 11)]
-    # mean UPB cents → dollars float64
-    mean_upb = feats[7]
-    feats[7] = Column(T.float64, mean_upb.data / 100.0,
-                      validity=mean_upb.validity)
     out = sort_table(Table(feats), [0])
     return out
 

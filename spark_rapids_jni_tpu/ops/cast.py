@@ -81,9 +81,15 @@ def _cast_string(col: Column, to: T.DType) -> Column:
             parsed = S.to_int64(col)
             if to == T.int64:
                 return parsed
-            info = np.iinfo(to.storage)
-            in_range = ((parsed.data >= info.min)
-                        & (parsed.data <= info.max))
+            if to.id == T.TypeId.UINT64:
+                # parse tops out below 2^63 (18-digit guard), so only the
+                # sign check matters; iinfo(uint64).max won't trace as an
+                # int64 constant
+                in_range = parsed.data >= 0
+            else:
+                info = np.iinfo(to.storage)
+                in_range = ((parsed.data >= info.min)
+                            & (parsed.data <= info.max))
             v = (in_range if parsed.validity is None
                  else (parsed.validity & in_range))
             return Column(to, parsed.data.astype(to.storage), validity=v)

@@ -569,25 +569,34 @@ def like(col: Column, pattern: str) -> Column:
 # Spark CAST(x AS STRING))
 # ---------------------------------------------------------------------------
 
-_POW10 = [10 ** k for k in range(19)]
+_POW10 = [10 ** k for k in range(20)]
 
 
 def _digit_matrix(mag: jnp.ndarray, width: int) -> jnp.ndarray:
-    """uint8 [n, width] ASCII digits of ``mag`` (int64 ≥ 0), right-aligned
-    at column width-1 — one fused divide/mod per digit position."""
+    """uint8 [n, width] ASCII digits of ``mag`` (int64/uint64 ≥ 0),
+    right-aligned at column width-1 — one fused divide/mod per position."""
     cols = []
     for p in range(width):
-        div = 10 ** (width - 1 - p)
+        div = jnp.asarray(10 ** (width - 1 - p), mag.dtype)
         cols.append(((mag // div) % 10).astype(jnp.uint8) + ord("0"))
     return jnp.stack(cols, axis=1)
 
 
-def _ndigits(mag: jnp.ndarray) -> jnp.ndarray:
-    """Decimal digit count of int64 mag ≥ 0 (0 → 1 digit)."""
+def _ndigits(mag: jnp.ndarray, up_to: int = 18) -> jnp.ndarray:
+    """Decimal digit count of mag ≥ 0 (0 → 1 digit); ``up_to`` is the
+    largest power-of-ten exponent compared (18 for int64, 19 for uint64)."""
     n = jnp.ones_like(mag, dtype=jnp.int32)
-    for k in range(1, 19):
-        n = n + (mag >= _POW10[k]).astype(jnp.int32)
+    for k in range(1, up_to + 1):
+        n = n + (mag >= jnp.asarray(_POW10[k], mag.dtype)).astype(jnp.int32)
     return n
+
+
+def _uint64_magnitude(v: jnp.ndarray):
+    """(magnitude as uint64, neg mask) — exact for INT64_MIN, whose
+    magnitude has no int64 representation."""
+    neg = v < 0
+    u = v.astype(jnp.uint64)
+    return jnp.where(neg, jnp.uint64(0) - u, u), neg
 
 
 def _matrix_to_strings(mat: jnp.ndarray, starts: jnp.ndarray,
@@ -606,68 +615,81 @@ def _matrix_to_strings(mat: jnp.ndarray, starts: jnp.ndarray,
     return Column(T.string, chars, new_offs, validity)
 
 
-def format_int64(col: Column) -> Column:
-    """Integer column → decimal strings (Spark CAST(x AS STRING)).
-
-    INT64_MIN is one past abs()'s range; it is handled by formatting
-    magnitude-minus-one digits… practically: values are first widened to
-    int64; -2^63 formats via the unsigned magnitude trick below.
-    """
-    v = col.data.astype(jnp.int64)
-    neg = v < 0
-    big = v == jnp.int64(-(2 ** 63))   # |INT64_MIN| overflows abs()
-    mag = jnp.where(big, 0, jnp.abs(v))
-    nd = _ndigits(mag)
-    W = 20  # '-' + 19 digits
+def _format_unsigned(mag: jnp.ndarray, neg: jnp.ndarray, validity) -> Column:
+    """uint64 magnitudes + sign mask → decimal strings."""
+    nd = _ndigits(mag, up_to=19)
+    W = 21  # '-' + up to 20 digits (2^64-1)
     digits = _digit_matrix(mag, W - 1)
-    mat = jnp.concatenate([jnp.full((v.shape[0], 1), ord("-"), jnp.uint8),
+    mat = jnp.concatenate([jnp.full((mag.shape[0], 1), ord("-"), jnp.uint8),
                            digits], axis=1)
     lens = nd + neg.astype(jnp.int32)
     starts = jnp.where(neg, (W - 1) - nd, W - nd)
     # '-' sits immediately before the first digit: copy it there
-    rows = jnp.arange(v.shape[0])
+    rows = jnp.arange(mag.shape[0])
     spos = jnp.maximum(starts, 0)
     mat = mat.at[rows, spos].set(
         jnp.where(neg, jnp.uint8(ord("-")), mat[rows, spos]))
-    # INT64_MIN: overwrite with the literal (its magnitude has no int64 rep)
-    lit = jnp.asarray(np.frombuffer(b"-9223372036854775808", np.uint8))
-    mat = jnp.where(big[:, None], lit[None, :], mat)
-    starts = jnp.where(big, 0, starts)
-    lens = jnp.where(big, W, lens)
-    return _matrix_to_strings(mat, starts, lens, col.validity)
+    return _matrix_to_strings(mat, starts, lens, validity)
+
+
+def format_int64(col: Column) -> Column:
+    """Integer column → decimal strings (Spark CAST(x AS STRING)).
+
+    All arithmetic runs on the uint64 magnitude, so INT64_MIN and uint64
+    values ≥ 2^63 format exactly (no abs() overflow, no wrap)."""
+    if col.data.dtype == jnp.uint64:
+        mag, neg = col.data, jnp.zeros(col.num_rows, bool)
+    else:
+        mag, neg = _uint64_magnitude(col.data.astype(jnp.int64))
+    return _format_unsigned(mag, neg, col.validity)
 
 
 def format_decimal(col: Column) -> Column:
     """decimal32/64 column → strings with the scale's fractional digits
-    ("123.45" for unscaled 12345 at scale -2); scale 0 formats as integers."""
+    ("123.45" for unscaled 12345 at scale -2); scale 0 formats as integers.
+
+    Runs on the uint64 magnitude (INT64_MIN-safe); positive scales append
+    literal zero digits instead of multiplying (which would wrap)."""
     if col.dtype.scale == 0:
         return format_int64(col)
+    mag, neg = _uint64_magnitude(col.data.astype(jnp.int64))
+    n = col.num_rows
     if col.dtype.scale > 0:
-        # positive scale: value = unscaled * 10^s — format the full integer
-        mul = 10 ** col.dtype.scale
-        return format_int64(Column(T.int64, col.data.astype(jnp.int64) * mul,
-                                   validity=col.validity))
+        # value = unscaled * 10^s: digits of |unscaled| + s zeros
+        s = col.dtype.scale
+        nd = _ndigits(mag, up_to=19)
+        W = 21
+        digits = _digit_matrix(mag, W - 1)
+        zeros = jnp.full((n, s), ord("0"), jnp.uint8)
+        mat = jnp.concatenate(
+            [jnp.full((n, 1), ord("-"), jnp.uint8), digits, zeros], axis=1)
+        lens = nd + s + neg.astype(jnp.int32)
+        starts = jnp.where(neg, (W - 1) - nd, W - nd)
+        rows = jnp.arange(n)
+        spos = jnp.maximum(starts, 0)
+        mat = mat.at[rows, spos].set(
+            jnp.where(neg, jnp.uint8(ord("-")), mat[rows, spos]))
+        return _matrix_to_strings(mat, starts, lens, col.validity)
     k = -col.dtype.scale
-    v = col.data.astype(jnp.int64)
-    neg = v < 0
-    mag = jnp.abs(v)
-    int_part = mag // (10 ** k)
-    frac = mag % (10 ** k)
-    nd_int = _ndigits(int_part)
-    WI = 19
+    div = jnp.uint64(10 ** k)
+    int_part = mag // div
+    frac = mag % div
+    nd_int = _ndigits(int_part, up_to=19)
+    WI = 20
     int_digits = _digit_matrix(int_part, WI)
     frac_digits = _digit_matrix(frac, k)
-    dot = jnp.full((v.shape[0], 1), ord("."), jnp.uint8)
-    sign = jnp.full((v.shape[0], 1), ord("-"), jnp.uint8)
+    dot = jnp.full((n, 1), ord("."), jnp.uint8)
+    sign = jnp.full((n, 1), ord("-"), jnp.uint8)
     mat = jnp.concatenate([sign, int_digits, dot, frac_digits], axis=1)
-    # layout inside mat: [0]='-', [1..WI]=int digits right-aligned,
-    # [WI+1]='.', [WI+2..]=frac.  The string starts at the sign (if neg)
-    # else at the first significant int digit.
+    # layout: [0]='-', [1..WI]=int digits right-aligned, [WI+1]='.',
+    # [WI+2..]=frac.  The string starts at the sign (if neg) else at the
+    # first significant int digit.
     first_digit = 1 + WI - nd_int
     starts = jnp.where(neg, first_digit - 1, first_digit)
-    mat = mat.at[jnp.arange(v.shape[0]), jnp.maximum(starts, 0)].set(
-        jnp.where(neg, jnp.uint8(ord("-")),
-                  mat[jnp.arange(v.shape[0]), jnp.maximum(starts, 0)]))
+    rows = jnp.arange(n)
+    spos = jnp.maximum(starts, 0)
+    mat = mat.at[rows, spos].set(
+        jnp.where(neg, jnp.uint8(ord("-")), mat[rows, spos]))
     lens = nd_int + 1 + k + neg.astype(jnp.int32)
     return _matrix_to_strings(mat, starts, lens, col.validity)
 

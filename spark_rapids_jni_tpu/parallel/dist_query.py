@@ -83,7 +83,7 @@ def _local_star_agg(num_groups: int, axis_name: str, dim_keys, dim_codes,
 
 def distributed_star_agg(mesh: jax.sharding.Mesh, dim: Dimension,
                          fact_key: jnp.ndarray, fact_value: jnp.ndarray,
-                         axis_name: str = "data"):
+                         axis_name="data"):
     """SELECT group, SUM(value), COUNT(*) FROM fact ⋈ dim GROUP BY group,
     executed SPMD over the mesh.
 
@@ -92,8 +92,15 @@ def distributed_star_agg(mesh: jax.sharding.Mesh, dim: Dimension,
     replicated (explicit P() specs — no closure capture under shard_map).
     Returns replicated ([num_groups] sums, [num_groups] counts) — group
     codes index them.
+
+    ``axis_name`` may be a tuple of mesh axes (e.g. ``("dcn", "ici")`` on a
+    2-D multi-host mesh): the fact table shards over all of them and the
+    final psum reduces over all of them — XLA lowers that to an ICI
+    all-reduce per host followed by one DCN all-reduce.
     """
-    fn = _compiled_star_agg(mesh, dim.num_groups, axis_name)
+    axis = tuple(axis_name) if isinstance(axis_name, (tuple, list)) \
+        else axis_name
+    fn = _compiled_star_agg(mesh, dim.num_groups, axis)
     return fn(dim.keys, dim.group_codes, fact_key, fact_value)
 
 

@@ -24,3 +24,16 @@ def make_mesh(n_devices: int | None = None,
                 "(tests use --xla_force_host_platform_device_count)")
         devs = devs[:n_devices]
     return jax.sharding.Mesh(np.array(devs), (axis_name,))
+
+
+def make_2d_mesh(n_hosts: int, chips_per_host: int,
+                 host_axis: str = "dcn", chip_axis: str = "ici"):
+    """2-D mesh (hosts × chips): the multi-host topology, with the slow DCN
+    axis outermost and ICI innermost (collectives should reduce over
+    ``chip_axis`` first / most often — "How to Scale Your Model" recipe)."""
+    devs = jax.devices()
+    need = n_hosts * chips_per_host
+    if len(devs) < need:
+        raise ValueError(f"need {need} devices, have {len(devs)}")
+    arr = np.array(devs[:need]).reshape(n_hosts, chips_per_host)
+    return jax.sharding.Mesh(arr, (host_axis, chip_axis))

@@ -109,3 +109,25 @@ def test_compiled_program_is_cached():
             is _compiled_star_agg(mesh, 5, "data"))
     assert (_compiled_star_agg(mesh, 5, "data")
             is not _compiled_star_agg(mesh, 6, "data"))
+
+
+def test_2d_multihost_mesh():
+    # 2 hosts x 4 chips: shard over both axes, reduce ICI then DCN
+    from spark_rapids_jni_tpu.parallel.mesh import make_2d_mesh
+    mesh = make_2d_mesh(2, 4)
+    rng = np.random.default_rng(7)
+    dim = prepare_dimension(
+        Column.from_numpy(np.arange(20, dtype=np.int64)),
+        Column.from_numpy((np.arange(20) % 4).astype(np.int32)))
+    fact_key = rng.integers(0, 25, 8 * 64).astype(np.int64)
+    fact_val = rng.integers(-10, 10, 8 * 64).astype(np.int64)
+    sums, cnts = distributed_star_agg(mesh, dim, jnp.asarray(fact_key),
+                                      jnp.asarray(fact_val),
+                                      axis_name=("dcn", "ici"))
+    hit = fact_key < 20
+    assert int(np.asarray(cnts).sum()) == int(hit.sum())
+    assert int(np.asarray(sums).sum()) == int(fact_val[hit].sum())
+    # per-group check vs numpy
+    for g in range(dim.num_groups):
+        sel = hit & ((fact_key % 4) == g)
+        assert int(np.asarray(sums)[g]) == int(fact_val[sel].sum())

@@ -365,3 +365,26 @@ class TestCastStringEdges:
             cast(c, T.string)
         with pytest.raises(NotImplementedError):
             cast(Column.strings_from_list(["1"]), T.timestamp_us)
+
+
+class TestFormatUnsignedAndDecimalEdges:
+    def test_uint64_above_2_63(self):
+        from spark_rapids_jni_tpu.ops import cast
+        c = Column.from_numpy(np.asarray([2**63, 2**64 - 1, 0], np.uint64))
+        assert cast(c, T.string).to_pylist() == \
+            ["9223372036854775808", "18446744073709551615", "0"]
+
+    def test_string_to_uint64(self):
+        from spark_rapids_jni_tpu.ops import cast
+        out = cast(Column.strings_from_list(["5", "-1", "42"]), T.uint64)
+        assert out.to_pylist() == [5, None, 42]
+
+    def test_decimal_int64_min(self):
+        c = Column.from_numpy(np.asarray([-(2**63)], np.int64),
+                              T.decimal64(-2))
+        assert S.format_decimal(c).to_pylist() == ["-92233720368547758.08"]
+
+    def test_decimal_positive_scale_no_wrap(self):
+        c = Column.from_numpy(np.asarray([10**18, -3], np.int64),
+                              T.decimal64(2))
+        assert S.format_decimal(c).to_pylist() == [str(10**20), "-300"]
