@@ -27,7 +27,8 @@ from ..column import Column, Table
 from .filter import gather
 from .sort import order_by
 
-_AGGS = ("sum", "count", "min", "max", "mean", "var", "std")
+_AGGS = ("sum", "count", "min", "max", "mean", "var", "std",
+         "first", "last")
 
 
 def _segment_ids(sorted_keys: list[jnp.ndarray],
@@ -59,6 +60,18 @@ def _agg_segment(data, valid, seg_ids, agg, num_segments, storage_kind):
         cnt = _agg_segment(data, valid, seg_ids, "count", num_segments,
                            storage_kind)
         return s.astype(jnp.float64) / jnp.maximum(cnt, 1).astype(jnp.float64)
+    if agg in ("first", "last"):
+        # first/last VALID value per group (Spark first/last ignoreNulls):
+        # min/max over valid row positions, then gather
+        n = data.shape[0]
+        pos = jnp.arange(n, dtype=jnp.int32)
+        if agg == "first":
+            vpos = pos if valid is None else jnp.where(valid, pos, n)
+            p = jax.ops.segment_min(vpos, seg_ids, num_segments)
+        else:
+            vpos = pos if valid is None else jnp.where(valid, pos, -1)
+            p = jax.ops.segment_max(vpos, seg_ids, num_segments)
+        return data[jnp.clip(p, 0, max(n - 1, 0))]
     if agg == "min":
         ident = np.inf if storage_kind == "f" else np.iinfo(data.dtype).max
         acc = data if valid is None else jnp.where(valid, data, ident)
@@ -176,12 +189,19 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
             out_cols.append(Column(dt, res.astype(dt.storage),
                                    validity=cnt >= 2))
             continue
+        if agg == "count":
+            kind = "i"          # count never touches the payload — allow
+        elif col.dtype.is_variable_width or col.dtype.is_nested:
+            raise NotImplementedError(
+                f"{agg!r} aggregation on {col.dtype.id.name} columns")
+        elif col.dtype.is_decimal and agg == "mean":
+            kind = "f"
+        else:
+            kind = col.dtype.storage.kind
         res = _agg_segment(data, col.validity, seg_ids, agg,
-                           num_segments,
-                           "f" if (col.dtype.is_decimal and agg == "mean")
-                           else col.dtype.storage.kind)
-        # min/max of an all-null group is null
-        if agg in ("min", "max") and col.validity is not None:
+                           num_segments, kind)
+        # min/max/first/last of an all-null group is null
+        if agg in ("min", "max", "first", "last") and col.validity is not None:
             cnt = _agg_segment(col.data, col.validity, seg_ids, "count",
                                num_segments, col.dtype.storage.kind)
             out_cols.append(Column(col.dtype, res.astype(col.dtype.storage),
@@ -196,7 +216,7 @@ def _agg_out_dtype(src, agg):
     """Result dtype of an aggregation — the single source for both the
     populated and the empty-input result paths (schema stability)."""
     from .. import types as T
-    if agg in ("min", "max"):
+    if agg in ("min", "max", "first", "last"):
         return src
     if agg in ("mean", "var", "std"):
         return T.float64
@@ -225,6 +245,16 @@ def _empty_result(table: Table, key_indices, aggs) -> Table:
 def _take_rows(col: Column, idx: jnp.ndarray) -> Column:
     v = None if col.validity is None else col.validity[idx]
     return Column(col.dtype, col.data[idx], validity=v)
+
+
+def groupby_nunique(table: Table, key_indices: Sequence[int],
+                    value_index: int) -> Table:
+    """COUNT(DISTINCT value) GROUP BY keys (Spark countDistinct, nulls
+    excluded): distinct (keys, value) tuples, then count non-null values
+    per key group — two sort passes, both fully vectorized."""
+    sub = groupby_aggregate(table, list(key_indices) + [value_index], [])
+    k = len(key_indices)
+    return groupby_aggregate(sub, list(range(k)), [(k, "count")])
 
 
 def distinct(table: Table) -> Table:

@@ -615,14 +615,22 @@ def _matrix_to_strings(mat: jnp.ndarray, starts: jnp.ndarray,
     return Column(T.string, chars, new_offs, validity)
 
 
-def _format_unsigned(mag: jnp.ndarray, neg: jnp.ndarray, validity) -> Column:
-    """uint64 magnitudes + sign mask → decimal strings."""
+def _format_unsigned(mag: jnp.ndarray, neg: jnp.ndarray, validity,
+                     trailing_zeros: int = 0) -> Column:
+    """uint64 magnitudes + sign mask → decimal strings.
+
+    ``trailing_zeros`` appends literal zero digits (positive decimal
+    scales) — except for magnitude 0, which stays "0"."""
     nd = _ndigits(mag, up_to=19)
     W = 21  # '-' + up to 20 digits (2^64-1)
     digits = _digit_matrix(mag, W - 1)
-    mat = jnp.concatenate([jnp.full((mag.shape[0], 1), ord("-"), jnp.uint8),
-                           digits], axis=1)
-    lens = nd + neg.astype(jnp.int32)
+    parts = [jnp.full((mag.shape[0], 1), ord("-"), jnp.uint8), digits]
+    if trailing_zeros:
+        parts.append(jnp.full((mag.shape[0], trailing_zeros), ord("0"),
+                              jnp.uint8))
+    mat = jnp.concatenate(parts, axis=1)
+    tz = jnp.where(mag == 0, 0, trailing_zeros).astype(jnp.int32)
+    lens = nd + tz + neg.astype(jnp.int32)
     starts = jnp.where(neg, (W - 1) - nd, W - nd)
     # '-' sits immediately before the first digit: copy it there
     rows = jnp.arange(mag.shape[0])
@@ -655,21 +663,10 @@ def format_decimal(col: Column) -> Column:
     mag, neg = _uint64_magnitude(col.data.astype(jnp.int64))
     n = col.num_rows
     if col.dtype.scale > 0:
-        # value = unscaled * 10^s: digits of |unscaled| + s zeros
-        s = col.dtype.scale
-        nd = _ndigits(mag, up_to=19)
-        W = 21
-        digits = _digit_matrix(mag, W - 1)
-        zeros = jnp.full((n, s), ord("0"), jnp.uint8)
-        mat = jnp.concatenate(
-            [jnp.full((n, 1), ord("-"), jnp.uint8), digits, zeros], axis=1)
-        lens = nd + s + neg.astype(jnp.int32)
-        starts = jnp.where(neg, (W - 1) - nd, W - nd)
-        rows = jnp.arange(n)
-        spos = jnp.maximum(starts, 0)
-        mat = mat.at[rows, spos].set(
-            jnp.where(neg, jnp.uint8(ord("-")), mat[rows, spos]))
-        return _matrix_to_strings(mat, starts, lens, col.validity)
+        # value = unscaled * 10^s: digits of |unscaled| + s literal zeros
+        # (multiplying would wrap int64)
+        return _format_unsigned(mag, neg, col.validity,
+                                trailing_zeros=col.dtype.scale)
     k = -col.dtype.scale
     div = jnp.uint64(10 ** k)
     int_part = mag // div

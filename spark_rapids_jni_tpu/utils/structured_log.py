@@ -1,0 +1,75 @@
+"""Structured logging knob (SURVEY §5.5 observability parity).
+
+The reference threads an RMM logging level from Maven into CMake
+(``pom.xml:81``, ``CMakeLists.txt:61-69``) and uses runtime-configurable
+spdlog in the fault injector (``faultinj.cu:379-386``).  The TPU-native
+equivalent is one env knob:
+
+  SPARK_RAPIDS_TPU_LOG=off|text|json     (default off)
+  SPARK_RAPIDS_TPU_LOG_FILE=<path>       (default stderr)
+
+When enabled, every ``@traced`` public entry emits one event record with
+wall-time duration — ``text`` for humans, ``json`` (one object per line)
+for log pipelines.  Re-read per process start; ``configure()`` overrides
+at runtime (the injector-style hot knob).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+_lock = threading.Lock()
+_mode: str = os.environ.get("SPARK_RAPIDS_TPU_LOG", "off").lower()
+_path: Optional[str] = os.environ.get("SPARK_RAPIDS_TPU_LOG_FILE")
+_stream = None
+
+
+def configure(mode: str | None = None, path: str | None = None) -> None:
+    """Override the env configuration at runtime ('off'|'text'|'json')."""
+    global _mode, _path, _stream
+    with _lock:
+        if mode is not None:
+            _mode = mode.lower()
+        if path is not None:
+            _path = path
+            if _stream is not None:
+                _stream.close()
+            _stream = None
+
+
+def enabled() -> bool:
+    return _mode in ("text", "json")
+
+
+def _out():
+    global _stream
+    if _path is None:
+        return sys.stderr
+    if _stream is None:
+        _stream = open(_path, "a", buffering=1)
+    return _stream
+
+
+def event(name: str, duration_s: float | None = None, **fields) -> None:
+    """Emit one structured event (no-op when the knob is off)."""
+    if not enabled():
+        return
+    with _lock:
+        out = _out()
+        if _mode == "json":
+            rec = {"ts": time.time(), "event": name}
+            if duration_s is not None:
+                rec["duration_ms"] = round(duration_s * 1e3, 3)
+            rec.update(fields)
+            out.write(json.dumps(rec) + "\n")
+        else:
+            extra = " ".join(f"{k}={v}" for k, v in fields.items())
+            dur = (f" {duration_s * 1e3:.3f}ms"
+                   if duration_s is not None else "")
+            out.write(f"[srjt] {name}{dur}{' ' + extra if extra else ''}\n")
+        out.flush()

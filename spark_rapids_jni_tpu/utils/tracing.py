@@ -12,6 +12,7 @@ from __future__ import annotations
 import contextlib
 import functools
 import os
+import time
 
 import jax
 
@@ -29,13 +30,24 @@ def func_range(name: str):
 
 
 def traced(name: str | None = None):
-    """Decorator form of :func:`func_range` (CUDF_FUNC_RANGE analog)."""
+    """Decorator form of :func:`func_range` (CUDF_FUNC_RANGE analog).
+
+    Also feeds the structured-log knob (``SPARK_RAPIDS_TPU_LOG``,
+    ``utils.structured_log``): when enabled, each call emits one event
+    record with wall-time duration — the RMM-logging/spdlog analog."""
 
     def wrap(fn):
         scope = name or fn.__qualname__
 
         @functools.wraps(fn)
         def inner(*args, **kwargs):
+            from . import structured_log as slog
+            if slog.enabled():
+                t0 = time.perf_counter()
+                with func_range(scope):
+                    out = fn(*args, **kwargs)
+                slog.event(scope, duration_s=time.perf_counter() - t0)
+                return out
             with func_range(scope):
                 return fn(*args, **kwargs)
 

@@ -337,3 +337,45 @@ class TestDecimalStatistics:
         out = ops.groupby_aggregate(t, [0], [(1, "var"), (1, "mean")])
         np.testing.assert_allclose(np.asarray(out[1].data), [2.0])
         np.testing.assert_allclose(np.asarray(out[2].data), [2.0])
+
+
+class TestFirstLastNunique:
+    def test_first_last_match_pandas(self):
+        rng = np.random.default_rng(6)
+        k = rng.integers(0, 8, 300).astype(np.int32)
+        v = rng.integers(-50, 50, 300).astype(np.int64)
+        valid = rng.random(300) < 0.8
+        t = Table([Column.from_numpy(k),
+                   Column.from_numpy(v, validity=valid)])
+        out = ops.groupby_aggregate(t, [0], [(1, "first"), (1, "last")])
+        df = pd.DataFrame({"k": k, "v": np.where(valid, v.astype(float),
+                                                 np.nan)})
+        exp = (df.groupby("k")["v"].agg(["first", "last"])
+               .reset_index().sort_values("k"))
+        # note: groupby sorts rows by key (stable), so "first" is the first
+        # valid value in ORIGINAL order within the group — pandas agrees
+        assert out[1].to_pylist() == \
+            [None if pd.isna(x) else int(x) for x in exp["first"]]
+        assert out[2].to_pylist() == \
+            [None if pd.isna(x) else int(x) for x in exp["last"]]
+
+    def test_nunique_matches_pandas(self):
+        rng = np.random.default_rng(7)
+        k = rng.integers(0, 5, 200).astype(np.int32)
+        s = [None if rng.random() < 0.1 else f"v{rng.integers(0, 7)}"
+             for _ in range(200)]
+        t = Table([Column.from_numpy(k), Column.strings_from_list(s)])
+        out = ops.groupby_nunique(t, [0], 1)
+        df = pd.DataFrame({"k": k, "s": s})
+        exp = (df.groupby("k")["s"].nunique().reset_index()
+               .sort_values("k"))
+        assert out[0].to_pylist() == exp["k"].tolist()
+        assert out[1].to_pylist() == exp["s"].tolist()
+
+    def test_string_value_agg_rejected_count_allowed(self):
+        t = Table([Column.from_numpy(np.asarray([1, 1], np.int32)),
+                   Column.strings_from_list(["a", None])])
+        out = ops.groupby_aggregate(t, [0], [(1, "count")])
+        assert out[1].to_pylist() == [1]
+        with pytest.raises(NotImplementedError):
+            ops.groupby_aggregate(t, [0], [(1, "first")])

@@ -388,3 +388,7 @@ class TestFormatUnsignedAndDecimalEdges:
         c = Column.from_numpy(np.asarray([10**18, -3], np.int64),
                               T.decimal64(2))
         assert S.format_decimal(c).to_pylist() == [str(10**20), "-300"]
+
+    def test_decimal_positive_scale_zero(self):
+        c = Column.from_numpy(np.asarray([0, 3], np.int64), T.decimal64(2))
+        assert S.format_decimal(c).to_pylist() == ["0", "300"]

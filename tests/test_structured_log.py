@@ -1,0 +1,46 @@
+"""Structured-logging knob (SURVEY §5.5): @traced entries emit one event
+record per call when SPARK_RAPIDS_TPU_LOG is on."""
+
+import json
+
+import numpy as np
+
+from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu.rowconv import convert_to_rows
+from spark_rapids_jni_tpu.utils import structured_log as slog
+
+
+def _run_traced_call():
+    t = Table([Column.from_numpy(np.arange(8, dtype=np.int32))])
+    convert_to_rows(t)
+
+
+def test_off_by_default(tmp_path):
+    p = tmp_path / "log.txt"
+    slog.configure(mode="off", path=str(p))
+    _run_traced_call()
+    assert not p.exists() or p.read_text() == ""
+
+
+def test_json_mode(tmp_path):
+    p = tmp_path / "log.jsonl"
+    slog.configure(mode="json", path=str(p))
+    try:
+        _run_traced_call()
+    finally:
+        slog.configure(mode="off")
+    lines = [json.loads(x) for x in p.read_text().splitlines()]
+    assert any(r["event"].startswith("convert_to_rows") for r in lines)
+    rec = lines[0]
+    assert "ts" in rec and rec["duration_ms"] >= 0
+
+
+def test_text_mode_and_fields(tmp_path):
+    p = tmp_path / "log.txt"
+    slog.configure(mode="text", path=str(p))
+    try:
+        slog.event("custom", duration_s=0.5, rows=10)
+    finally:
+        slog.configure(mode="off")
+    txt = p.read_text()
+    assert "[srjt] custom" in txt and "500.000ms" in txt and "rows=10" in txt
