@@ -168,10 +168,20 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
 
     for vi, agg in aggs:
         col = sorted_tbl[vi]
+        if agg == "count":
+            # count never touches the payload — every column type counts
+            res = _agg_segment(col.data, col.validity, seg_ids, "count",
+                               num_segments, "i")
+            dt = _agg_out_dtype(col.dtype, agg)
+            out_cols.append(Column(dt, res.astype(dt.storage)))
+            continue
+        if col.dtype.is_variable_width or col.dtype.is_nested:
+            raise NotImplementedError(
+                f"{agg!r} aggregation on {col.dtype.id.name} columns")
         if col.dtype.id == T.TypeId.DECIMAL128:
             if agg != "sum":
                 raise NotImplementedError(
-                    f"decimal128 groupby supports sum only, got {agg!r}")
+                    f"decimal128 groupby supports sum/count only, got {agg!r}")
             from . import decimal128 as d128
             out_cols.append(d128.segmented_sum(col, seg_ids, num_segments))
             continue
@@ -189,15 +199,8 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
             out_cols.append(Column(dt, res.astype(dt.storage),
                                    validity=cnt >= 2))
             continue
-        if agg == "count":
-            kind = "i"          # count never touches the payload — allow
-        elif col.dtype.is_variable_width or col.dtype.is_nested:
-            raise NotImplementedError(
-                f"{agg!r} aggregation on {col.dtype.id.name} columns")
-        elif col.dtype.is_decimal and agg == "mean":
-            kind = "f"
-        else:
-            kind = col.dtype.storage.kind
+        kind = "f" if (col.dtype.is_decimal and agg == "mean") \
+            else col.dtype.storage.kind
         res = _agg_segment(data, col.validity, seg_ids, agg,
                            num_segments, kind)
         # min/max/first/last of an all-null group is null

@@ -107,6 +107,21 @@ def _from_bytes(b: jnp.ndarray, storage: np.dtype) -> jnp.ndarray:
     return jax.lax.bitcast_convert_type(b, jnp.dtype(storage))
 
 
+def _byte_view_dt(data: jnp.ndarray, dt) -> jnp.ndarray:
+    """DType-aware ``_byte_view``: DECIMAL128 [n, 2] int64 → u8 [n, 16]."""
+    if dt.id == T.TypeId.DECIMAL128:
+        return jax.lax.bitcast_convert_type(data, jnp.uint8).reshape(
+            data.shape[0], 16)
+    return _byte_view(data, dt.storage)
+
+
+def _from_bytes_dt(b: jnp.ndarray, dt) -> jnp.ndarray:
+    """DType-aware ``_from_bytes``: u8 [n, 16] → DECIMAL128 [n, 2] int64."""
+    if dt.id == T.TypeId.DECIMAL128:
+        return jax.lax.bitcast_convert_type(b.reshape(-1, 2, 8), jnp.int64)
+    return _from_bytes(b, dt.storage)
+
+
 def _stage(col: Column) -> jnp.ndarray:
     """Payload handed to the jit cores; f64 becomes uint32 [n, 2] halves."""
     if col.dtype.is_fixed_width and _is_f64(col.dtype.storage):
@@ -120,6 +135,12 @@ def _unstage(data: jnp.ndarray, storage: np.dtype) -> jnp.ndarray:
         return jnp.asarray(
             np.ascontiguousarray(np.asarray(data)).view(np.float64).reshape(-1))
     return data
+
+
+def _unstage_dt(data: jnp.ndarray, dt) -> jnp.ndarray:
+    if dt.id == T.TypeId.DECIMAL128:
+        return data               # [n, 2] int64 lanes ARE the payload
+    return _unstage(data, dt.storage)
 
 
 # ---------------------------------------------------------------------------
@@ -160,7 +181,7 @@ def _to_rows_fixed_impl(layout: RowLayout, use_pallas: bool,
     def padrows(x):
         return jnp.pad(x, [(0, n_pad - n)] + [(0, 0)] * (x.ndim - 1))
 
-    staged = [padrows(pk._stage_column(d, dt.storage))
+    staged = [padrows(pk._stage_column_dt(d, dt))
               for d, dt in zip(datas, layout.schema)]
     vbytes_w = []
     for k in range(layout.validity_bytes):
@@ -251,6 +272,12 @@ def _from_rows_fixed_impl(layout: RowLayout, use_pallas: bool,
     for ci, dt in enumerate(layout.schema):
         start = layout.column_starts[ci]
         size = layout.column_sizes[ci]
+        if size == 16:   # DECIMAL128: four words → [n, 2] int64 lanes
+            quad = jnp.stack([word(start // 4 + j) for j in range(4)],
+                             axis=1)[:n]
+            datas.append(jax.lax.bitcast_convert_type(
+                quad.reshape(-1, 2, 2), jnp.int64))
+            continue
         st = dt.storage
         if size == 8:
             pair = jnp.stack([word(start // 4), word(start // 4 + 1)],
@@ -359,7 +386,7 @@ def _var_fixed_region(layout: RowLayout, datas: tuple[jnp.ndarray, ...],
             slot = jnp.stack([slot_off, lens[:, vi].astype(jnp.uint32)], axis=1)
             b = jax.lax.bitcast_convert_type(slot, jnp.uint8).reshape(n, 8)
         else:
-            b = _byte_view(datas[ci], dt.storage)
+            b = _byte_view_dt(datas[ci], dt)
         fixed2d = fixed2d.at[:, start:start + b.shape[1]].set(b)
     vbytes = bitmask.pack_bool_matrix(valid)
     return fixed2d.at[:, layout.validity_offset:
@@ -455,7 +482,7 @@ def _var_fixed_extract(layout: RowLayout, fixed_dense: jnp.ndarray):
             datas.append(None)
         else:
             b = fixed_dense[:, start:start + layout.column_sizes[ci]]
-            datas.append(_from_bytes(b, dt.storage))
+            datas.append(_from_bytes_dt(b, dt))
     vbytes = fixed_dense[:, layout.validity_offset:
                          layout.validity_offset + layout.validity_bytes]
     valid = bitmask.unpack_bool_matrix(vbytes, layout.num_columns)
@@ -518,7 +545,7 @@ def _to_rows_var(layout: RowLayout, total_bytes: int,
             slot = jnp.stack([slot_off, lens[:, vi].astype(jnp.uint32)], axis=1)
             b = jax.lax.bitcast_convert_type(slot, jnp.uint8).reshape(n, 8)
         else:
-            b = _byte_view(datas[ci], dt.storage)
+            b = _byte_view_dt(datas[ci], dt)
         fixed2d = fixed2d.at[:, start:start + b.shape[1]].set(b)
     vbytes = bitmask.pack_bool_matrix(valid)
     fixed2d = fixed2d.at[:, layout.validity_offset:
@@ -604,7 +631,7 @@ def _from_rows_var(layout: RowLayout, char_totals: tuple[int, ...],
         sz = layout.column_sizes[ci]
         pos = row_base[:, None] + start + jnp.arange(sz)[None, :]
         b = data[pos.reshape(-1)].reshape(n, sz)
-        datas.append(_from_bytes(b, dt.storage))
+        datas.append(_from_bytes_dt(b, dt))
 
     pos = (row_base[:, None] + layout.validity_offset
            + jnp.arange(layout.validity_bytes)[None, :])
@@ -761,7 +788,7 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
             (pallas_kernels.fixed_pallas_enabled()
              and pallas_kernels.layout_supported(layout)),
             batch.data)
-        cols = [Column(dt, _unstage(datas[ci], dt.storage), validity=valids[ci])
+        cols = [Column(dt, _unstage_dt(datas[ci], dt), validity=valids[ci])
                 for ci, dt in enumerate(schema)]
         return Table(cols)
 
@@ -838,7 +865,7 @@ def _assemble(schema, datas, valid, chars, out_offsets) -> Table:
             cols.append(Column(dt, chars[vi], out_offsets[vi], v))
             vi += 1
         else:
-            cols.append(Column(dt, _unstage(datas[ci], dt.storage), validity=v))
+            cols.append(Column(dt, _unstage_dt(datas[ci], dt), validity=v))
     return Table(cols)
 
 

@@ -73,10 +73,11 @@ def compute_row_layout(schema: Sequence[T.DType]) -> RowLayout:
     variable: list[int] = []
     offset = 0
     for i, dt in enumerate(schema):
-        if dt.is_nested or dt.id == T.TypeId.DECIMAL128:
-            # Same contract as the reference: JCUDF rows carry fixed-width +
-            # string columns only; nested types are rejected at entry
-            # (row_conversion.cu:1268-1271 is_fixed_width || is_compound).
+        if dt.is_nested:
+            # Same contract as the reference: JCUDF rows carry fixed-width
+            # (incl. decimal128, fixed-width in libcudf) + string columns;
+            # nested types are rejected at entry (row_conversion.cu:1268-1271
+            # is_fixed_width || is_compound).
             raise TypeError(
                 f"column {i}: {dt.id.name} is not supported in JCUDF rows")
         size = dt.itemsize

@@ -67,7 +67,10 @@ def _word_plan(layout: RowLayout):
     for ci, dt in enumerate(layout.schema):
         start = layout.column_starts[ci]
         size = layout.column_sizes[ci]
-        if size == 8:
+        if size == 16:   # DECIMAL128: staged u32 [n, 4], four words
+            for j in range(4):
+                plan[start // 4 + j].append((ci, "pair", j))
+        elif size == 8:
             plan[start // 4].append((ci, "pair", 0))
             plan[start // 4 + 1].append((ci, "pair", 1))
         elif size == 4:
@@ -80,6 +83,17 @@ def _word_plan(layout: RowLayout):
         byte = vo + k
         plan[byte // 4].append((vi, "vbyte", (k, byte % 4)))
     return plan
+
+
+def _stage_column_dt(data: jnp.ndarray, dt) -> jnp.ndarray:
+    """DType-aware staging: DECIMAL128's [n, 2] int64 lanes become u32
+    [n, 4] (lo_lo, lo_hi, hi_lo, hi_hi — little-endian word order);
+    everything else delegates on the storage dtype."""
+    from .. import types as T
+    if dt.id == T.TypeId.DECIMAL128:
+        return jax.lax.bitcast_convert_type(
+            data, jnp.uint32).reshape(data.shape[0], 4)
+    return _stage_column(data, dt.storage)
 
 
 def _stage_column(data: jnp.ndarray, storage: np.dtype) -> jnp.ndarray:
@@ -282,6 +296,9 @@ _MAX_PLAN_WORDS = 64
 
 def layout_supported(layout: RowLayout) -> bool:
     """Static per-schema gate for the Pallas fixed-width kernels."""
+    from .. import types as T
+    if any(dt.id == T.TypeId.DECIMAL128 for dt in layout.schema):
+        return False   # d128 rides the XLA path only (no 16B kernel plan)
     max_words = int(os.environ.get("SRJT_PALLAS_MAX_WORDS", _MAX_PLAN_WORDS))
     return layout.fixed_row_size // 4 <= max_words
 

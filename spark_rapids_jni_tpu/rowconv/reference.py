@@ -56,6 +56,9 @@ def to_rows_np(table: Table) -> tuple[np.ndarray, np.ndarray]:
                 chars = np.asarray(col.data)[offs[r]:offs[r + 1]]
                 out[base + var_cursor:base + var_cursor + length] = chars
                 var_cursor += length
+            elif col.dtype.id.name == "DECIMAL128":
+                lanes = np.asarray(col.data[r], dtype=np.int64)  # (lo, hi)
+                out[start:start + 16] = lanes.view(np.uint8)
             else:
                 val = np.asarray(col.data[r:r + 1], dtype=col.dtype.storage)
                 sz = layout.column_sizes[ci]
@@ -85,6 +88,8 @@ def from_rows_np(row_bytes: np.ndarray, row_offsets: np.ndarray,
     for ci, dt in enumerate(schema):
         if dt.is_variable_width:
             datas.append([])  # list of per-row bytes
+        elif dt.id == T.TypeId.DECIMAL128:
+            datas.append(np.zeros((n, 2), dtype=np.int64))
         else:
             datas.append(np.zeros(n, dtype=dt.storage))
 
@@ -99,6 +104,8 @@ def from_rows_np(row_bytes: np.ndarray, row_offsets: np.ndarray,
                 slot = row_bytes[start:start + 8].view(np.uint32)
                 off, length = int(slot[0]), int(slot[1])
                 datas[ci].append(row_bytes[base + off:base + off + length])
+            elif dt.id == T.TypeId.DECIMAL128:
+                datas[ci][r] = row_bytes[start:start + 16].view(np.int64)
             else:
                 sz = layout.column_sizes[ci]
                 datas[ci][r] = row_bytes[start:start + sz].view(dt.storage)[0]
@@ -116,6 +123,11 @@ def from_rows_np(row_bytes: np.ndarray, row_offsets: np.ndarray,
             import jax.numpy as jnp
             cols.append(Column(dt, jnp.asarray(chars), jnp.asarray(offs),
                                None if v is None else jnp.asarray(v)))
+        elif dt.id == T.TypeId.DECIMAL128:
+            import jax.numpy as jnp
+            cols.append(Column(dt, jnp.asarray(datas[ci]),
+                               validity=None if v is None
+                               else jnp.asarray(v)))
         else:
             cols.append(Column.from_numpy(datas[ci], dt, v))
     return Table(cols)

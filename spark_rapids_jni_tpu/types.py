@@ -176,17 +176,23 @@ class DType:
         """
         if self.is_variable_width:
             return 8
+        if self.id == TypeId.DECIMAL128:
+            return 16
         return self.storage.itemsize
 
     @property
     def row_alignment(self) -> int:
         """Alignment of this column's slot within a JCUDF row.
 
-        Fixed-width columns align to their own size; variable-width slots
-        align to 4 (two uint32s) — ``row_conversion.cu:1348-1350``.
+        Fixed-width columns align to their own size (DECIMAL128 to 16,
+        matching the reference's align-to-size rule,
+        ``row_conversion.cu:1331-1370``); variable-width slots align to 4
+        (two uint32s) — ``row_conversion.cu:1348-1350``.
         """
         if self.is_variable_width:
             return 4
+        if self.id == TypeId.DECIMAL128:
+            return 16
         return self.storage.itemsize
 
     def __repr__(self) -> str:
