@@ -1,0 +1,50 @@
+"""Mini TPC-H lineitem generator for the Q1 pricing-summary query.
+
+Decimal measures are written as parquet DECIMAL (FLBA) so the framework's
+decimal decode path feeds the query; flags are low-cardinality strings like
+the spec's returnflag/linestatus.
+"""
+
+from __future__ import annotations
+
+import decimal
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+
+def generate(n: int = 50_000, seed: int = 21) -> tuple[bytes, dict]:
+    rng = np.random.default_rng(seed)
+    epoch98 = 10561    # days 1970 → 1998-12-01
+    qty = rng.integers(1, 51, n).astype(np.int64)
+    price_c = rng.integers(90_000, 10_000_000, n)        # cents
+    disc_c = rng.integers(0, 11, n)                      # 0.00-0.10
+    tax_c = rng.integers(0, 9, n)                        # 0.00-0.08
+    ship = rng.integers(epoch98 - 2500, epoch98 + 100, n).astype(np.int32)
+    flags = np.where(rng.random(n) < 0.5, "N",
+                     np.where(rng.random(n) < 0.5, "A", "R"))
+    status = np.where(flags == "N", "O", "F")
+
+    table = pa.table({
+        "l_returnflag": pa.array(flags.tolist()),
+        "l_linestatus": pa.array(status.tolist()),
+        "l_quantity": pa.array(qty),
+        "l_extendedprice": pa.array(
+            [decimal.Decimal(int(c)) / 100 for c in price_c],
+            pa.decimal128(12, 2)),
+        "l_discount": pa.array(
+            [decimal.Decimal(int(c)) / 100 for c in disc_c],
+            pa.decimal128(4, 2)),
+        "l_tax": pa.array(
+            [decimal.Decimal(int(c)) / 100 for c in tax_c],
+            pa.decimal128(4, 2)),
+        "l_shipdate": pa.array(ship, pa.date32()),
+    })
+    buf = io.BytesIO()
+    pq.write_table(table, buf, compression="SNAPPY")
+    raw = {"flags": flags, "status": status, "qty": qty,
+           "price_c": price_c, "disc_c": disc_c, "tax_c": tax_c,
+           "ship": ship}
+    return buf.getvalue(), raw

@@ -1,0 +1,68 @@
+"""TPC-H Q1 pricing summary — the decimal-arithmetic aggregation query.
+
+    SELECT l_returnflag, l_linestatus,
+           sum(l_quantity), sum(l_extendedprice),
+           sum(l_extendedprice*(1-l_discount))            AS sum_disc_price,
+           sum(l_extendedprice*(1-l_discount)*(1+l_tax))  AS sum_charge,
+           avg(l_quantity), avg(l_extendedprice), avg(l_discount),
+           count(*)
+    FROM lineitem WHERE l_shipdate <= ? GROUP BY 1,2 ORDER BY 1,2
+
+Exercises the full decimal path end-to-end: FLBA decimal decode →
+decimal64 columns → widening to DECIMAL128 lane pairs → exact 128-bit
+products (scale -4 / -6, Spark's result-scale rule) → decimal128
+groupby-SUM with two-string-key grouping — all device-side limb
+arithmetic, no floats anywhere near the money columns.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import types as T
+from ..column import Column, Table
+from ..ops import apply_boolean_mask, decimal128 as d128
+from ..ops import groupby_aggregate
+from ..parquet import decode
+
+COLUMNS = ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+           "l_discount", "l_tax", "l_shipdate"]
+
+
+def run(file_bytes: bytes, cutoff_days: int) -> Table:
+    """Returns [returnflag, linestatus, sum_qty, sum_base_price,
+    sum_disc_price(d128,-4), sum_charge(d128,-6), avg_qty, avg_price,
+    avg_disc, count], sorted by the two flags."""
+    t = decode.read_table(file_bytes, columns=COLUMNS)
+    mask = t.columns[6].data <= cutoff_days
+    if t.columns[6].validity is not None:
+        mask = mask & t.columns[6].validity
+    t = apply_boolean_mask(t, mask)   # WHERE removes rows (Spark semantics)
+    flag, status, qty, price, disc, tax, _ = t.columns
+
+    # 1 - discount and 1 + tax as unscaled decimal64 at scale -2
+    one_minus_disc = Column(T.decimal64(-2),
+                            100 - disc.data.astype(jnp.int64),
+                            validity=disc.validity)
+    one_plus_tax = Column(T.decimal64(-2),
+                          100 + tax.data.astype(jnp.int64),
+                          validity=tax.validity)
+
+    # exact decimal products on 128-bit lanes (scales add: -2 + -2 = -4 …)
+    price_w = d128.widen(price)
+    disc_price = d128.mul(price_w, d128.widen(one_minus_disc))     # scale -4
+    charge = d128.mul(disc_price, d128.widen(one_plus_tax))        # scale -6
+
+    work = Table([flag, status, qty, price, disc_price, charge, disc])
+    # groupby output is already key-ordered (order-preserving dictionary
+    # codes for the string keys) — no final sort needed
+    return groupby_aggregate(
+        work, [0, 1],
+        [(2, "sum"),      # sum_qty
+         (3, "sum"),      # sum_base_price  (decimal64, scale kept)
+         (4, "sum"),      # sum_disc_price  (decimal128 limb sum)
+         (5, "sum"),      # sum_charge      (decimal128 limb sum)
+         (2, "mean"),     # avg_qty
+         (3, "mean"),     # avg_price (value domain)
+         (6, "mean"),     # avg_disc  (value domain)
+         (2, "count")])   # count(*)

@@ -225,23 +225,25 @@ def _agg_out_dtype(src, agg):
         return T.float64
     if agg == "count":
         return T.int64
+    if src.id == T.TypeId.DECIMAL128:    # limb sum keeps type AND scale
+        return src
     if src.is_decimal:                   # sum of decimal keeps the scale
         return T.decimal64(src.scale)
     return T.float64 if src.storage.kind == "f" else T.int64
 
 
+def _empty_column_of(dt) -> Column:
+    if dt.is_variable_width:
+        return Column(dt, jnp.zeros(0, jnp.uint8), jnp.zeros(1, jnp.int32))
+    if dt.id == T.TypeId.DECIMAL128:
+        return Column(dt, jnp.zeros((0, 2), jnp.int64))
+    return Column(dt, jnp.zeros(0, dt.storage))
+
+
 def _empty_result(table: Table, key_indices, aggs) -> Table:
-    cols = []
-    for ki in key_indices:
-        dt = table[ki].dtype
-        if dt.is_variable_width:
-            cols.append(Column(dt, jnp.zeros(0, jnp.uint8),
-                               jnp.zeros(1, jnp.int32)))
-        else:
-            cols.append(Column(dt, jnp.zeros(0, dt.storage)))
+    cols = [_empty_column_of(table[ki].dtype) for ki in key_indices]
     for vi, agg in aggs:
-        dt = _agg_out_dtype(table[vi].dtype, agg)
-        cols.append(Column(dt, jnp.zeros(0, dt.storage)))
+        cols.append(_empty_column_of(_agg_out_dtype(table[vi].dtype, agg)))
     return Table(cols)
 
 

@@ -1,0 +1,70 @@
+"""TPC-H Q1 differential: exact-integer oracle for the decimal128 money
+sums, pandas for the float statistics."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from benchmarks import tpch_data
+from spark_rapids_jni_tpu.models import tpch_q1
+from spark_rapids_jni_tpu import types as T
+
+CUTOFF = 10561 - 90   # 1998-12-01 minus ~90 days, in epoch days
+
+
+@pytest.fixture(scope="module")
+def data():
+    return tpch_data.generate(n=20_000, seed=9)
+
+
+def test_q1_matches_exact_oracle(data):
+    file_bytes, raw = data
+    out = tpch_q1.run(file_bytes, CUTOFF)
+
+    df = pd.DataFrame({k: v for k, v in raw.items()})
+    df = df[df.ship <= CUTOFF]
+    # exact integer oracle in unscaled units
+    df["disc_price_u"] = df.price_c * (100 - df.disc_c)          # scale -4
+    df["charge_u"] = df.disc_price_u * (100 + df.tax_c)          # scale -6
+    g = (df.groupby(["flags", "status"])
+         .agg(sum_qty=("qty", "sum"),
+              sum_price_c=("price_c", "sum"),
+              sum_disc_price_u=("disc_price_u", "sum"),
+              sum_charge_u=("charge_u", "sum"),
+              avg_qty=("qty", "mean"),
+              avg_price_c=("price_c", "mean"),
+              avg_disc_c=("disc_c", "mean"),
+              cnt=("qty", "size"))
+         .reset_index().sort_values(["flags", "status"]))
+
+    assert out.num_rows == len(g)
+    assert out[0].to_pylist() == g["flags"].tolist()
+    assert out[1].to_pylist() == g["status"].tolist()
+    assert out[2].to_pylist() == g.sum_qty.tolist()
+    # decimal64 base-price sum keeps scale -2
+    assert out[3].dtype == T.decimal64(-2)
+    assert out[3].to_pylist() == g.sum_price_c.tolist()
+    # decimal128 limb sums are EXACT integers at scales -4 / -6
+    assert out[4].dtype == T.decimal128(-4)
+    assert out[4].to_pylist() == g.sum_disc_price_u.tolist()
+    assert out[5].dtype == T.decimal128(-6)
+    assert out[5].to_pylist() == g.sum_charge_u.tolist()
+    # float statistics (value domain: decimals carry their scale)
+    np.testing.assert_allclose(np.asarray(out[6].data),
+                               g.avg_qty.to_numpy(), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(out[7].data),
+                               g.avg_price_c.to_numpy() / 100.0, rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(out[8].data),
+                               g.avg_disc_c.to_numpy() / 100.0, rtol=1e-12)
+    assert out[9].to_pylist() == g.cnt.tolist()
+
+
+def test_q1_empty_after_cutoff(data):
+    file_bytes, _ = data
+    out = tpch_q1.run(file_bytes, -10**6)
+    assert out.num_rows == 0
+    # empty-path schema must match the populated path (incl. [0,2] lanes)
+    assert out[3].dtype == T.decimal64(-2)
+    assert out[4].dtype == T.decimal128(-4)
+    assert out[4].data.shape == (0, 2)
+    assert out[5].dtype == T.decimal128(-6)
