@@ -1,0 +1,121 @@
+"""Arrow interchange (cudf ``to_arrow``/``from_arrow`` analog).
+
+cudf columns ARE Arrow layout on device; this framework's columns are the
+same layout in HBM (data + int32 offsets + validity), so interchange is a
+buffer-level mapping, not a conversion: fixed-width payloads, string
+offsets/chars, single-level lists, and decimals (Arrow decimal128 ↔ the
+[n,2] int64 lane representation).  pyarrow is an optional dependency —
+import errors surface only when these functions are called.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from ..column import Column, Table
+
+
+def _pa():
+    import pyarrow as pa
+    return pa
+
+
+_PA_FIXED = {
+    "int8": T.int8, "int16": T.int16, "int32": T.int32, "int64": T.int64,
+    "uint8": T.uint8, "uint16": T.uint16, "uint32": T.uint32,
+    "uint64": T.uint64, "float": T.float32, "double": T.float64,
+    "date32[day]": T.timestamp_days,
+    "timestamp[s]": T.timestamp_seconds, "timestamp[ms]": T.timestamp_ms,
+    "timestamp[us]": T.timestamp_us, "timestamp[ns]": T.timestamp_ns,
+}
+
+
+def from_arrow(arr) -> Column:
+    """pyarrow Array / ChunkedArray → device Column."""
+    pa = _pa()
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    t = arr.type
+    validity = None
+    if arr.null_count:
+        validity = np.asarray(arr.is_valid())
+    if pa.types.is_decimal(t):
+        from ..ops import decimal128 as d128
+        import decimal
+        with decimal.localcontext() as ctx:
+            ctx.prec = 41      # default 28-digit context would round d128
+            vals = [None if v is None else int(v.scaleb(t.scale))
+                    for v in arr.to_pylist()]
+        col = d128.from_pyints(vals, scale=-t.scale)
+        if t.precision <= 18:
+            from ..ops import cast
+            narrow_to = (T.decimal32(-t.scale) if t.precision <= 9
+                         else T.decimal64(-t.scale))
+            return cast(col, narrow_to)
+        return col
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return Column.strings_from_list(arr.to_pylist())
+    if pa.types.is_list(t):
+        return Column.list_from_pylist(arr.to_pylist())
+    if pa.types.is_boolean(t):
+        data = np.asarray([bool(v) if v is not None else False
+                           for v in arr.to_pylist()], np.uint8)
+        return Column.from_numpy(data, T.bool8, validity)
+    key = str(t)
+    if key in _PA_FIXED:
+        dt = _PA_FIXED[key]
+        if validity is not None:
+            # fill nulls in ARROW space: to_numpy on a nullable int array
+            # falls back to float64 and corrupts values above 2^53
+            arr = arr.fill_null(pa.scalar(0, t))
+        np_arr = np.asarray(arr.to_numpy(zero_copy_only=False))
+        # datetime64 payloads → raw storage
+        np_arr = np_arr.astype(dt.storage, casting="unsafe")
+        return Column.from_numpy(np_arr, dt, validity)
+    raise NotImplementedError(f"from_arrow: unsupported Arrow type {t}")
+
+
+def to_arrow(col: Column):
+    """Device Column → pyarrow Array (host copy)."""
+    pa = _pa()
+    dt = col.dtype
+    if dt.id == T.TypeId.STRING:
+        return pa.array(col.to_pylist(), pa.string())
+    if dt.id == T.TypeId.LIST:
+        return pa.array(col.to_pylist())
+    if dt.is_decimal:
+        scale = -dt.scale
+        vals = col.to_pylist()
+        import decimal
+        with decimal.localcontext() as ctx:
+            ctx.prec = 41      # default context rounds 29+ digit values
+            converted = [None if v is None else
+                         decimal.Decimal(v).scaleb(-scale) for v in vals]
+        return pa.array(converted, pa.decimal128(38, scale))
+    if dt.id == T.TypeId.BOOL8:
+        return pa.array(col.to_pylist(), pa.bool_())
+    if dt.id == T.TypeId.TIMESTAMP_DAYS:
+        return pa.array(col.to_pylist(), pa.date32())
+    if dt.is_timestamp:
+        unit = {T.TypeId.TIMESTAMP_SECONDS: "s",
+                T.TypeId.TIMESTAMP_MILLISECONDS: "ms",
+                T.TypeId.TIMESTAMP_MICROSECONDS: "us",
+                T.TypeId.TIMESTAMP_NANOSECONDS: "ns"}[dt.id]
+        return pa.array(col.to_pylist(), pa.timestamp(unit))
+    return pa.array(col.to_pylist(), pa.from_numpy_dtype(dt.storage))
+
+
+def table_from_arrow(tbl) -> Table:
+    """pyarrow Table → device Table (column order preserved)."""
+    return Table([from_arrow(tbl.column(i))
+                  for i in range(tbl.num_columns)])
+
+
+def table_to_arrow(table: Table, names=None):
+    """Device Table → pyarrow Table."""
+    pa = _pa()
+    names = names or [f"c{i}" for i in range(table.num_columns)]
+    # from_arrays keeps duplicate names (a dict would silently drop them)
+    return pa.Table.from_arrays([to_arrow(c) for c in table.columns],
+                                names=list(names))

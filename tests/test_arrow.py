@@ -1,0 +1,95 @@
+"""Arrow interchange round-trips (cudf to_arrow/from_arrow analog)."""
+
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_jni_tpu import types as T
+from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu.utils import arrow as A
+
+
+class TestRoundTrip:
+    def test_fixed_types(self):
+        for typ, vals in [
+            (pa.int32(), [1, None, -3]),
+            (pa.int64(), [2**40, 0, None]),
+            (pa.float64(), [1.5, None, -2.25]),
+            (pa.uint8(), [0, 255, None]),
+            (pa.bool_(), [True, None, False]),
+            (pa.date32(), [0, 18321, None]),
+            (pa.timestamp("us"), [0, 10**15, None]),
+        ]:
+            arr = pa.array(vals, typ)
+            col = A.from_arrow(arr)
+            back = A.to_arrow(col)
+            assert back.to_pylist() == arr.to_pylist(), typ
+
+    def test_strings_and_lists(self):
+        arr = pa.array(["a", None, "bcd", ""])
+        assert A.to_arrow(A.from_arrow(arr)).to_pylist() == arr.to_pylist()
+        lst = pa.array([[1, 2], None, [], [5]], pa.list_(pa.int64()))
+        col = A.from_arrow(lst)
+        assert col.dtype.id == T.TypeId.LIST
+        assert A.to_arrow(col).to_pylist() == lst.to_pylist()
+
+    def test_decimals(self):
+        small = pa.array([decimal.Decimal("1.25"), None], pa.decimal128(7, 2))
+        col = A.from_arrow(small)
+        assert col.dtype == T.decimal32(-2)
+        assert A.to_arrow(col).to_pylist() == small.to_pylist()
+        big = pa.array([decimal.Decimal("123456789012345678901.55"), None],
+                       pa.decimal128(30, 2))
+        col = A.from_arrow(big)
+        assert col.dtype == T.decimal128(-2)
+        assert A.to_arrow(col).to_pylist() == big.to_pylist()
+
+    def test_table_roundtrip(self):
+        tbl = pa.table({"a": pa.array([1, 2], pa.int32()),
+                        "s": pa.array(["x", None]),
+                        "d": pa.array([decimal.Decimal("9.99")] * 2,
+                                      pa.decimal128(10, 2))})
+        t = A.table_from_arrow(tbl)
+        assert t.num_columns == 3 and t.num_rows == 2
+        back = A.table_to_arrow(t, names=["a", "s", "d"])
+        assert back.column("a").to_pylist() == [1, 2]
+        assert back.column("s").to_pylist() == ["x", None]
+        assert back.column("d").to_pylist() == tbl.column("d").to_pylist()
+
+    def test_chunked_array(self):
+        ch = pa.chunked_array([pa.array([1, 2], pa.int64()),
+                               pa.array([3], pa.int64())])
+        assert A.to_arrow(A.from_arrow(ch)).to_pylist() == [1, 2, 3]
+
+    def test_unsupported_type_raises(self):
+        with pytest.raises(NotImplementedError):
+            A.from_arrow(pa.array([{"a": 1}], pa.struct([("a", pa.int64())])))
+
+
+class TestReviewRegressions:
+    def test_38_digit_decimal_exact(self):
+        v = decimal.Decimal("123456789012345678901234567890.12")
+        arr = pa.array([v, None], pa.decimal128(38, 2))
+        col = A.from_arrow(arr)
+        assert col.to_pylist()[0] == int(
+            decimal.Decimal("12345678901234567890123456789012"))
+        assert A.to_arrow(col).to_pylist() == [v, None]
+
+    def test_nullable_int64_above_2_53(self):
+        arr = pa.array([2**62 + 1, None], pa.int64())
+        col = A.from_arrow(arr)
+        assert col.to_pylist() == [2**62 + 1, None]
+
+    def test_decimal64_19_digit_unscaled(self):
+        col = Column.from_numpy(
+            np.asarray([9223372036854775807], np.int64), T.decimal64(-2))
+        out = A.to_arrow(col)
+        assert out.to_pylist() == [decimal.Decimal("92233720368547758.07")]
+
+    def test_duplicate_names_preserved(self):
+        t = Table([Column.from_numpy(np.asarray([1], np.int32)),
+                   Column.from_numpy(np.asarray([2], np.int32))])
+        out = A.table_to_arrow(t, names=["k", "k"])
+        assert out.num_columns == 2
