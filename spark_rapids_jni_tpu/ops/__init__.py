@@ -3,7 +3,7 @@ from . import strings  # noqa: F401
 from . import window  # noqa: F401
 from .cast import cast  # noqa: F401
 from .filter import (apply_boolean_mask, fill_null, gather,  # noqa: F401
-                     mask_table)
+                     isin, mask_table)
 from .copying import concat_tables, slice_table  # noqa: F401
 from .groupby import (distinct, groupby_aggregate,  # noqa: F401
                       groupby_nunique)

@@ -85,3 +85,51 @@ def fill_null(col: Column, value) -> Column:
     data = jnp.where(col.validity, col.data,
                      jnp.asarray(value, col.data.dtype))
     return Column(col.dtype, data, validity=None)
+
+
+def isin(col: Column, values) -> jnp.ndarray:
+    """Null-safe SQL ``col IN (v1, v2, …)`` mask (Spark semantics: null
+    rows yield False).  Fixed-width columns probe a sorted value list with
+    one searchsorted; string columns OR a few vectorized equality passes
+    (IN-lists are short in practice)."""
+    if col.dtype.id == T.TypeId.STRING:
+        from . import strings
+        payloads = [v.encode() if isinstance(v, str) else bytes(v)
+                    for v in values if v is not None]
+        m = jnp.zeros(col.num_rows, bool)
+        if payloads:
+            # one shared byte matrix; per-value compare is a masked row-AND
+            mat, lens = strings._search_matrix(
+                col, max(len(p) for p in payloads))
+            for p in payloads:
+                eq = jnp.asarray(lens == len(p))
+                for k, b in enumerate(p):
+                    eq = eq & (mat[:, k] == b)
+                m = m | eq
+    elif col.dtype.is_nested or col.dtype.id == T.TypeId.DECIMAL128:
+        raise NotImplementedError(f"isin on {col.dtype.id.name}")
+    else:
+        # keep only probes that survive an EXACT round trip into the
+        # column's storage dtype — a lossy cast (3.5 → 3 into int32, or an
+        # out-of-range literal) must match nothing, not its truncation;
+        # None (SQL NULL) literals never match non-null rows
+        storage = col.dtype.storage
+        kept = []
+        for v in values:
+            if v is None:
+                continue
+            try:
+                cast_v = storage.type(v)
+            except (OverflowError, ValueError, TypeError):
+                continue
+            if cast_v == v:
+                kept.append(cast_v)
+        if not kept:
+            return jnp.zeros(col.num_rows, bool)
+        vals = jnp.sort(jnp.asarray(np.asarray(kept, storage)))
+        pos = jnp.clip(jnp.searchsorted(vals, col.data), 0,
+                       vals.shape[0] - 1)
+        m = vals[pos] == col.data
+    if col.validity is not None:
+        m = m & col.validity
+    return m

@@ -11,6 +11,7 @@ import pytest
 
 from spark_rapids_jni_tpu import types as T
 from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu import ops
 from spark_rapids_jni_tpu.ops import (concat_tables, cumulative_count,
                                       cumulative_max, cumulative_min,
                                       cumulative_sum, distinct, slice_table)
@@ -159,3 +160,37 @@ class TestReviewRegressions:
         from spark_rapids_jni_tpu.ops import decimal128 as d128
         with pytest.raises(TypeError):
             cumulative_sum(d128.from_pyints([1]))
+
+
+class TestIsin:
+    def test_isin_ints_vs_pandas(self):
+        rng = np.random.default_rng(11)
+        vals = rng.integers(0, 50, 300).astype(np.int32)
+        valid = rng.random(300) < 0.9
+        col = Column.from_numpy(vals, validity=valid)
+        wanted = [3, 7, 49, 100]
+        got = np.asarray(ops.isin(col, wanted))
+        want = pd.Series(vals).isin(wanted).to_numpy() & valid
+        np.testing.assert_array_equal(got, want)
+
+    def test_isin_strings(self):
+        col = Column.strings_from_list(["a", "bb", None, "c", "bb"])
+        got = np.asarray(ops.isin(col, ["bb", "c"]))
+        np.testing.assert_array_equal(got, [False, True, False, True, True])
+
+    def test_isin_empty_list(self):
+        col = Column.from_numpy(np.arange(4, dtype=np.int64))
+        assert not np.asarray(ops.isin(col, [])).any()
+
+    def test_isin_lossy_probes_match_nothing(self):
+        col = Column.from_numpy(np.asarray([3, 4], np.int32))
+        assert np.asarray(ops.isin(col, [3.5])).tolist() == [False, False]
+        assert np.asarray(ops.isin(col, [3.0, None])).tolist() == [True,
+                                                                   False]
+        ucol = Column.from_numpy(np.asarray([1], np.uint32))
+        assert np.asarray(ops.isin(ucol, [-1])).tolist() == [False]
+
+    def test_isin_string_none_entry(self):
+        col = Column.strings_from_list(["a", "b"])
+        assert np.asarray(ops.isin(col, ["a", None])).tolist() == [True,
+                                                                   False]
