@@ -81,7 +81,7 @@ def to_rows_from_handle(table_handle: int) -> int:
         table = _table_from_handle(lib, table_handle)
         batches = convert_to_rows(table)
         for b in batches:
-            data = np.ascontiguousarray(np.asarray(b.data))
+            data = np.ascontiguousarray(b.host_bytes())
             offs = np.ascontiguousarray(np.asarray(b.offsets,
                                                    dtype=np.int32))
             nrows = offs.shape[0] - 1

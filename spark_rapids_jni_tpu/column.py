@@ -17,6 +17,12 @@ the reference leans on (SURVEY §2.9: ``make_fixed_width_column`` /
   ``utils.bitmask`` for interchange (and for the JCUDF validity bytes).
 * BOOL8 columns store uint8 0/1 payloads (JCUDF stores bools as one byte,
   ``RowConversion.java:60-67``).
+* FLOAT64 columns store their IEEE754 **bit pattern** as uint32 [n, 2]
+  (lo, hi half-words), not a float64 array: XLA:TPU cannot bitcast its
+  emulated f64, so bit-level storage makes the JCUDF transcode and Parquet
+  DOUBLE decode pure byte movement on every backend (``utils.f64bits``).
+  Compute ops convert at their boundaries via :meth:`Column.values` /
+  :meth:`Column.from_values`.
 """
 
 from __future__ import annotations
@@ -96,6 +102,9 @@ class Column:
         if dtype is None:
             dtype = T.from_numpy(arr.dtype)
         storage = np.ascontiguousarray(arr, dtype=dtype.storage)
+        if dtype.id == T.TypeId.FLOAT64:
+            from .utils import f64bits
+            storage = f64bits.np_to_bits(storage)   # exact host-side view
         v = None if validity is None else jnp.asarray(np.asarray(validity, dtype=bool))
         return Column(dtype, jnp.asarray(storage), validity=v)
 
@@ -147,9 +156,31 @@ class Column:
         dtype = T.struct_(*[f.dtype for f in fields])
         return Column(dtype, jnp.zeros((0,), jnp.uint8), None, v, fields)
 
+    # -- value <-> bit-pattern boundary (FLOAT64 storage invariant) ---------
+    def values(self) -> jnp.ndarray:
+        """Arithmetic payload: FLOAT64 bit pairs decode to f64 values;
+        every other dtype returns ``data`` as-is."""
+        if self.dtype.id == T.TypeId.FLOAT64:
+            from .utils import f64bits
+            return f64bits.from_bits(self.data)
+        return self.data
+
+    @staticmethod
+    def from_values(dtype: T.DType, vals: jnp.ndarray,
+                    validity=None) -> "Column":
+        """Build a column from arithmetic values, encoding FLOAT64 to its
+        uint32 [n, 2] bit-pattern storage."""
+        if dtype.id == T.TypeId.FLOAT64:
+            from .utils import f64bits
+            vals = f64bits.to_bits(vals.astype(jnp.float64))
+        return Column(dtype, vals, validity=validity)
+
     # -- host round-trip (tests / interchange) ------------------------------
     def to_numpy(self) -> np.ndarray:
         """Host copy of the payload (fixed-width columns only)."""
+        if self.dtype.id == T.TypeId.FLOAT64:
+            from .utils import f64bits
+            return f64bits.np_from_bits(np.asarray(self.data))
         return np.asarray(self.data)
 
     def to_pylist(self):
@@ -180,7 +211,7 @@ class Column:
             hi = lanes[:, 1].astype(np.int64)
             return [int(hi[i]) * (1 << 64) + int(lo[i]) if valid[i] else None
                     for i in range(self.num_rows)]
-        vals = np.asarray(self.data)
+        vals = self.to_numpy()
         if self.dtype.id == T.TypeId.BOOL8:
             vals = vals.astype(bool)
         return [vals[i].item() if valid[i] else None for i in range(self.num_rows)]

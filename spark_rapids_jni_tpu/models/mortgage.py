@@ -102,6 +102,6 @@ def feature_matrix(files: dict[str, bytes]):
     t = etl(files)
     lanes = []
     for c in t.columns[1:]:
-        data = cast(c, T.float64).data if c.dtype.is_decimal else c.data
+        data = cast(c, T.float64).values() if c.dtype.is_decimal else c.values()
         lanes.append(data.astype(jnp.float32))
     return t[0].data, jnp.stack(lanes, axis=1)

@@ -40,7 +40,7 @@ def q6_kernel(quantity, extendedprice, discount, shipdate,
 def run(file_bytes: bytes, date_lo_days: int, date_hi_days: int):
     """Scan a lineitem parquet file and compute Q6 revenue on device."""
     table = decode.read_table(file_bytes, columns=COLUMNS)
-    q, ep, disc, ship = (table[i].data for i in range(4))
+    q, ep, disc, ship = (table[i].values() for i in range(4))
     revenue, matched = q6_kernel(q, ep, disc, ship,
                                  jnp.int32(date_lo_days),
                                  jnp.int32(date_hi_days))

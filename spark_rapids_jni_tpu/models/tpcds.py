@@ -50,7 +50,7 @@ def _eq_scalar_mask(col: Column, value) -> "np.ndarray":
         b = S.equal_to_scalar(col, value)
         m = b.data.astype(bool)
         return m if b.validity is None else (m & b.validity)
-    m = col.data == value
+    m = col.values() == value
     return m if col.validity is None else (m & col.validity)
 
 
@@ -63,10 +63,11 @@ def _range_mask(col: Column, lo=None, hi=None, hi_strict: bool = False):
     upper bound exclusive), null-safe like ``_eq_scalar_mask`` — keeps the
     validity AND in one place."""
     m = None
+    cvals = col.values()
     if lo is not None:
-        m = col.data >= lo
+        m = cvals >= lo
     if hi is not None:
-        hm = (col.data < hi) if hi_strict else (col.data <= hi)
+        hm = (cvals < hi) if hi_strict else (cvals <= hi)
         m = hm if m is None else (m & hm)
     if col.validity is not None:
         m = col.validity if m is None else (m & col.validity)

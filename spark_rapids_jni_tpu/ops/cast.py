@@ -30,7 +30,7 @@ def cast(col: Column, to: T.DType) -> Column:
     if src.id == T.TypeId.DECIMAL128 or to.id == T.TypeId.DECIMAL128:
         return _cast_decimal128(col, to)
 
-    data = col.data
+    data = col.values()   # FLOAT64 bit pairs decode to f64 values
     if src.is_decimal and to.is_decimal:
         data = _rescale(data, src.scale, to.scale).astype(to.storage)
     elif src.is_decimal:
@@ -50,8 +50,9 @@ def cast(col: Column, to: T.DType) -> Column:
     elif to.id == T.TypeId.BOOL8:
         data = (data != 0).astype(jnp.uint8)
     else:
-        data = data.astype(to.storage)
-    return Column(to, data, validity=col.validity)
+        data = data.astype(to.storage if to.id != T.TypeId.FLOAT64
+                           else jnp.float64)
+    return Column.from_values(to, data, validity=col.validity)
 
 
 def _cast_string(col: Column, to: T.DType) -> Column:
@@ -135,7 +136,7 @@ def _cast_decimal128(col: Column, to: T.DType) -> Column:
         # would silently wrap above 2^63).  Exact on CPU; on TPU, f64
         # div/floor are emulated and may be a few ulp off above 2^64.
         scaled = jnp.round(
-            col.data.astype(jnp.float64) * np.float64(10.0) ** (-to.scale))
+            col.values().astype(jnp.float64) * np.float64(10.0) ** (-to.scale))
         neg = scaled < 0
         mag = jnp.abs(scaled)
         hi_f = jnp.floor(mag / (2.0 ** 64))

@@ -301,4 +301,4 @@ def to_float64(a: Column) -> Column:
     hival = hi.astype(jnp.float64) + jnp.where(hi < 0, 2.0 ** 64, 0.0)
     val = hival * (2.0 ** 64) + loval
     val = jnp.where(neg, -val, val) * (10.0 ** a.dtype.scale)
-    return Column(T.float64, val, validity=a.validity)
+    return Column.from_values(T.float64, val, validity=a.validity)

@@ -82,8 +82,14 @@ def fill_null(col: Column, value) -> Column:
         raise TypeError(f"fill_null not supported on {col.dtype.id.name}")
     if col.validity is None:
         return col
-    data = jnp.where(col.validity, col.data,
-                     jnp.asarray(value, col.data.dtype))
+    if col.dtype.id == T.TypeId.FLOAT64:   # bit-pair storage: fill with bits
+        from ..utils import f64bits
+        fill = jnp.asarray(f64bits.np_to_bits(
+            np.asarray([value], np.float64))[0])
+        data = jnp.where(col.validity[:, None], col.data, fill[None, :])
+    else:
+        data = jnp.where(col.validity, col.data,
+                         jnp.asarray(value, col.data.dtype))
     return Column(col.dtype, data, validity=None)
 
 
@@ -127,9 +133,10 @@ def isin(col: Column, values) -> jnp.ndarray:
         if not kept:
             return jnp.zeros(col.num_rows, bool)
         vals = jnp.sort(jnp.asarray(np.asarray(kept, storage)))
-        pos = jnp.clip(jnp.searchsorted(vals, col.data), 0,
+        cdata = col.values()   # FLOAT64 bit pairs decode to f64 values
+        pos = jnp.clip(jnp.searchsorted(vals, cdata), 0,
                        vals.shape[0] - 1)
-        m = vals[pos] == col.data
+        m = vals[pos] == cdata
     if col.validity is not None:
         m = m & col.validity
     return m

@@ -142,7 +142,14 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
     skeys, svalid = [], []
     for ki in key_indices:
         col = sorted_tbl[ki]
-        if col.dtype.id == T.TypeId.DECIMAL128:   # compare both lanes
+        if col.dtype.id == T.TypeId.FLOAT64:
+            # bit-pair lanes canonicalized for Spark grouping equality
+            # (-0.0 == 0.0, all NaNs equal)
+            from ..utils.f64bits import group_key_lanes
+            lo, hi = group_key_lanes(col.data)
+            skeys += [lo, hi]
+            svalid += [col.validity, col.validity]
+        elif col.dtype.id == T.TypeId.DECIMAL128:   # compare both limbs
             skeys += [col.data[:, 0], col.data[:, 1]]
             svalid += [col.validity, col.validity]
         else:
@@ -185,7 +192,7 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
             from . import decimal128 as d128
             out_cols.append(d128.segmented_sum(col, seg_ids, num_segments))
             continue
-        data = col.data
+        data = col.values()   # FLOAT64 bit pairs decode to f64 values
         if col.dtype.is_decimal and agg in ("mean", "var", "std"):
             # value-domain statistics: apply the decimal scale (the raw
             # payload is unscaled — var over cents would be off by 10^-2s)
@@ -195,9 +202,8 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
                                num_segments, "i")
             res = _var_segment(data, col.validity, seg_ids, num_segments,
                                cnt, std=(agg == "std"))
-            dt = _agg_out_dtype(col.dtype, agg)
-            out_cols.append(Column(dt, res.astype(dt.storage),
-                                   validity=cnt >= 2))
+            out_cols.append(Column.from_values(
+                _agg_out_dtype(col.dtype, agg), res, validity=cnt >= 2))
             continue
         kind = "f" if (col.dtype.is_decimal and agg == "mean") \
             else col.dtype.storage.kind
@@ -205,14 +211,22 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
                            num_segments, kind)
         # min/max/first/last of an all-null group is null
         if agg in ("min", "max", "first", "last") and col.validity is not None:
-            cnt = _agg_segment(col.data, col.validity, seg_ids, "count",
+            cnt = _agg_segment(data, col.validity, seg_ids, "count",
                                num_segments, col.dtype.storage.kind)
-            out_cols.append(Column(col.dtype, res.astype(col.dtype.storage),
-                                   validity=cnt > 0))
+            out_cols.append(Column.from_values(
+                col.dtype, _cast_res(res, col.dtype), validity=cnt > 0))
         else:
             dt = _agg_out_dtype(col.dtype, agg)
-            out_cols.append(Column(dt, res.astype(dt.storage)))
+            out_cols.append(Column.from_values(dt, _cast_res(res, dt)))
     return Table(out_cols)
+
+
+def _cast_res(res, dt):
+    """Aggregate result → the dtype's arithmetic value form (FLOAT64 stays a
+    f64 value array; ``Column.from_values`` encodes it to bit pairs)."""
+    if dt.id == T.TypeId.FLOAT64:
+        return res.astype(jnp.float64)
+    return res.astype(dt.storage)
 
 
 def _agg_out_dtype(src, agg):
@@ -237,6 +251,8 @@ def _empty_column_of(dt) -> Column:
         return Column(dt, jnp.zeros(0, jnp.uint8), jnp.zeros(1, jnp.int32))
     if dt.id == T.TypeId.DECIMAL128:
         return Column(dt, jnp.zeros((0, 2), jnp.int64))
+    if dt.id == T.TypeId.FLOAT64:
+        return Column(dt, jnp.zeros((0, 2), jnp.uint32))
     return Column(dt, jnp.zeros(0, dt.storage))
 
 

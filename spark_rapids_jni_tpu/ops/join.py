@@ -26,7 +26,7 @@ from .filter import gather
 
 def _key_with_nulls_last(col: Column):
     """Key lane where null rows are moved past any real key (never match)."""
-    data = col.data
+    data = col.values()   # FLOAT64 bit pairs decode to sortable f64 values
     if col.validity is None:
         return data, None
     return data, col.validity

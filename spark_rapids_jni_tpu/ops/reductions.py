@@ -16,9 +16,10 @@ from ..column import Column
 
 
 def _masked(col: Column, identity):
+    data = col.values()   # FLOAT64 bit pairs decode to f64 values
     if col.validity is None:
-        return col.data
-    return jnp.where(col.validity, col.data, identity)
+        return data
+    return jnp.where(col.validity, data, identity)
 
 
 def valid_count(col: Column) -> jnp.ndarray:

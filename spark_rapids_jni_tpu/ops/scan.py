@@ -32,7 +32,7 @@ def _scan(col: Column, op: str) -> Column:
     if (col.dtype.is_variable_width or col.dtype.is_nested
             or col.dtype.id == T.TypeId.DECIMAL128):
         raise TypeError(f"scan not supported on {col.dtype.id.name}")
-    data = col.data
+    data = col.values()   # FLOAT64 bit pairs decode to f64 values
     out_dt = col.dtype
     if op == "sum":
         # accumulate in 64-bit like Spark's running sum; decimals keep
@@ -51,6 +51,8 @@ def _scan(col: Column, op: str) -> Column:
         res = jax_cummin(data)
     else:
         res = jax_cummax(data)
+    if out_dt.id == T.TypeId.FLOAT64:
+        return Column.from_values(out_dt, res, validity=col.validity)
     return Column(out_dt, res.astype(out_dt.storage), validity=col.validity)
 
 

@@ -34,6 +34,13 @@ def order_by(table: Table, keys: Sequence[int],
         elif col.dtype.id.name == "DECIMAL128":
             from . import decimal128 as d128
             key_lanes = d128.sort_key_lanes(col, descending=not asc)
+        elif col.dtype.id.name == "FLOAT64":
+            # storage is the IEEE bit pattern (u32 [n, 2]); the classic
+            # monotone bits->uint mapping (negatives inverted, positives
+            # sign-flipped) sorts numerically EXACTLY with no f64
+            # arithmetic, and NaN (max exponent, nonzero mantissa) lands
+            # above +inf — Spark's NaN-largest order — in both directions.
+            key_lanes = f64_sort_key_lanes(col, descending=not asc)
         else:
             data = col.data
             if not asc:
@@ -51,6 +58,27 @@ def order_by(table: Table, keys: Sequence[int],
             null_rank = jnp.where(col.validity, 1, 0 if nf else 2)
             lanes.append(null_rank)   # appended after → higher priority
     return jnp.lexsort(tuple(lanes))
+
+
+def f64_sort_key_lanes(col, descending: bool = False) -> list[jnp.ndarray]:
+    """Order-preserving u32 lanes for a FLOAT64 bit-pair column, in
+    increasing lexsort priority (lo lane first, hi lane last).
+
+    All NaNs (either sign, any payload) map to the single maximum key —
+    Spark's NaN-largest total order — before the optional descending
+    inversion, so NaN sorts last ascending and first descending."""
+    from ..utils.f64bits import is_nan_bits
+    lo = col.data[:, 0]
+    hi = col.data[:, 1]
+    nan = is_nan_bits(lo, hi)
+    neg = (hi >> jnp.uint32(31)) != 0
+    hi_k = jnp.where(nan, jnp.uint32(0xFFFFFFFF),
+                     jnp.where(neg, ~hi, hi ^ jnp.uint32(0x80000000)))
+    lo_k = jnp.where(nan, jnp.uint32(0xFFFFFFFF),
+                     jnp.where(neg, ~lo, lo))
+    if descending:
+        hi_k, lo_k = ~hi_k, ~lo_k
+    return [lo_k, hi_k]
 
 
 def sort_table(table: Table, keys: Sequence[int],

@@ -47,7 +47,14 @@ class WindowSpec:
             head = head.at[0].set(True)
         for ki in partition_by:
             col = table[ki]
-            if col.dtype.id == T.TypeId.DECIMAL128:
+            if col.dtype.id == T.TypeId.FLOAT64:
+                # bit pairs canonicalized (-0.0 == 0.0, NaNs equal — Spark
+                # grouping equality)
+                from ..utils.f64bits import group_key_lanes
+                lo, hi = group_key_lanes(col.data)
+                k = jnp.stack([lo, hi], axis=1)[self.order]
+                neq = (k[1:] != k[:-1]).any(axis=1)
+            elif col.dtype.id == T.TypeId.DECIMAL128:
                 k = col.data[self.order]
                 neq = (k[1:] != k[:-1]).any(axis=1)
             elif col.dtype.is_variable_width:
@@ -82,6 +89,10 @@ class WindowSpec:
                         dtype: T.DType, validity=None) -> Column:
         vals = sorted_vals[self.inv]
         v = None if validity is None else validity[self.inv]
+        if dtype.id == T.TypeId.FLOAT64:
+            if vals.ndim == 2:          # already u32 bit pairs (shift path)
+                return Column(dtype, vals, validity=v)
+            return Column.from_values(dtype, vals, validity=v)
         return Column(dtype, vals.astype(dtype.storage), validity=v)
 
 
@@ -104,9 +115,13 @@ def _order_change(spec: WindowSpec, order_keys: Sequence[int]) -> jnp.ndarray:
             from . import strings
             codes, _ = strings.dictionary_encode(col)
             k = codes.data[spec.order]
+        elif col.dtype.id == T.TypeId.FLOAT64:
+            from ..utils.f64bits import group_key_lanes
+            lo, hi = group_key_lanes(col.data)
+            k = jnp.stack([lo, hi], axis=1)[spec.order]
         else:
             k = col.data[spec.order]
-        if k.ndim == 2:   # decimal128 lanes
+        if k.ndim == 2:   # decimal128 limbs / canonical f64 bit lanes
             neq = (k[1:] != k[:-1]).any(axis=1)
         else:
             neq = k[1:] != k[:-1]
@@ -182,7 +197,7 @@ def running_sum(spec: WindowSpec, value_col: int) -> Column:
     acc_dt = (T.decimal64(col.dtype.scale) if col.dtype.is_decimal
               else T.float64 if col.dtype.storage.kind == "f"
               else T.int64)
-    data = col.data[spec.order].astype(acc_dt.storage)
+    data = col.values()[spec.order].astype(acc_dt.storage)
     sv = None if col.validity is None else col.validity[spec.order]
     if sv is not None:
         data = jnp.where(sv, data, 0)
@@ -206,7 +221,7 @@ def _running_extreme(spec: WindowSpec, value_col: int, is_max: bool) -> Column:
     through the scan instead."""
     col = spec.table[value_col]
     _check_scannable(col)
-    data = col.data[spec.order]
+    data = col.values()[spec.order]   # FLOAT64 bit pairs decode to values
     sv = None if col.validity is None else col.validity[spec.order]
     kind = col.dtype.storage.kind
     if is_max:

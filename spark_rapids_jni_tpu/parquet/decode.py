@@ -635,9 +635,12 @@ def _present_leaf_column(leaf: _Leaf, values, lens, valid) -> Column:
         full = np.zeros(nrows, dtype=values.dtype)
         full[valid] = values
         values = full
-    return Column(dt, jnp.asarray(np.ascontiguousarray(values,
-                                                       dtype=dt.storage)),
-                  validity=jvalid)
+    host = np.ascontiguousarray(values, dtype=dt.storage)
+    if dt.id == T.TypeId.FLOAT64:
+        # Column invariant: f64 payloads upload as u32 bit pairs (exact)
+        from ..utils import f64bits
+        host = f64bits.np_to_bits(host)
+    return Column(dt, jnp.asarray(host), validity=jvalid)
 
 
 def _n_present(leaf, values, lens):

@@ -6,10 +6,15 @@ translation (see SURVEY §7):
 
 * The reference hand-tiles shared memory and double-buffers
   ``cuda::memcpy_async`` (``row_conversion.cu:575-693,892-993``).  On TPU the
-  transpose is expressed as pure array ops — per-column byte views
-  (``lax.bitcast_convert_type``) written into a [rows, row_size] byte matrix —
-  and XLA fuses the whole thing into a handful of vectorized HBM passes; a
-  Pallas kernel (``pallas_kernels.py``) covers the cases XLA schedules poorly.
+  fixed-width transcode works at u32-word granularity end to end: each row
+  word is composed from a statically-planned set of column fragments
+  (shift/or tree), and the column->row interleave is one layout-preserving
+  3-D permute (or, for wide rows, one 2-D transpose) whose output minor
+  dimension is a 128-lane multiple.  Measured on the target chip
+  (tools/profile_transcode.py, round 3) these formulations run at 250-750
+  GB/s vs ~45-135 GB/s for strided lane writes and ~22 GB/s for a final
+  u32->u8 repack — which is why :class:`RowBatch` carries the row bytes AS
+  u32 words (JCUDF rows are 8-byte aligned, so the words are exact).
 * The warp-ballot validity transpose (``row_conversion.cu:710-810``)
   becomes a weighted-sum bit pack (``utils.bitmask.pack_bool_matrix``).
 * Variable-width (string) handling follows the reference's two-phase shape
@@ -48,10 +53,19 @@ from .layout import (RowLayout, compute_row_layout, build_batches,
 @dataclasses.dataclass
 class RowBatch:
     """One ≤2GB batch of JCUDF rows: the LIST<INT8> column analog
-    (``row_conversion.cu:1869-1889``)."""
+    (``row_conversion.cu:1869-1889``).
 
-    data: jnp.ndarray      # uint8 [total_bytes]
-    offsets: jnp.ndarray   # int32 [num_rows + 1]
+    ``data`` is the packed row byte stream, stored either as uint8
+    [total_bytes] (variable-width batches, byte-granular DMA engine) or as
+    uint32 [total_bytes/4] little-endian words (fixed-width batches — rows
+    are 8-byte aligned so the word view is exact, and keeping words avoids
+    a ~22 GB/s u32->u8 relayout pass on TPU).  Both views describe the
+    identical JCUDF byte stream; :meth:`host_bytes` is the canonical byte
+    materialization.
+    """
+
+    data: jnp.ndarray      # uint8 [total_bytes] or uint32 [total_bytes/4]
+    offsets: jnp.ndarray   # int32 [num_rows + 1] byte offsets
 
     def tree_flatten(self):
         return (self.data, self.offsets), None
@@ -66,28 +80,31 @@ class RowBatch:
 
     @property
     def num_bytes(self) -> int:
-        return self.data.shape[0]
+        return self.data.shape[0] * self.data.dtype.itemsize
+
+    def host_bytes(self) -> np.ndarray:
+        """The JCUDF byte stream as host uint8 (exact for either storage)."""
+        raw = np.ascontiguousarray(np.asarray(self.data))
+        return raw.view(np.uint8)
+
+    def device_u8(self) -> jnp.ndarray:
+        """The byte stream as a device uint8 array (converts if u32)."""
+        if self.data.dtype == jnp.uint8:
+            return self.data
+        return _words_to_bytes(self.data)
 
 
 def _is_f64(storage: np.dtype) -> bool:
     return storage.kind == "f" and storage.itemsize == 8
 
 
-# Row-word count above which the fixed transcode interleaves via one
-# [W, n] transpose instead of W strided lane writes/reads: strided ops
-# don't fuse, costing W full passes (O(W²) at the reference's 212-column
-# bench schema), while [n, W]'s lane padding is ≤ ~2× once W > 48.
-_W_STRIDED_MAX = 48
-
-
 def _byte_view(data: jnp.ndarray, storage: np.dtype) -> jnp.ndarray:
-    """[n] fixed-width values → uint8 [n, itemsize] (little-endian).
+    """[n] fixed-width payload → uint8 [n, itemsize] (little-endian).
 
-    FLOAT64 payloads arrive *staged* as uint32 [n, 2] (see ``_stage``):
-    XLA:TPU emulates f64 and exposes no bit-level access to it
-    (``bitcast_convert_type`` on f64 fails in the x64-rewrite pass), so the
-    transcode — which only moves bytes, never does arithmetic — works on the
-    u32 halves instead.
+    FLOAT64 payloads are uint32 [n, 2] bit pairs by Column invariant
+    (``utils.f64bits`` — XLA:TPU exposes no bit-level access to its emulated
+    f64), so the transcode — which only moves bytes, never does arithmetic —
+    works on the u32 halves.
     """
     if _is_f64(storage):
         return jax.lax.bitcast_convert_type(data, jnp.uint8).reshape(
@@ -99,7 +116,7 @@ def _byte_view(data: jnp.ndarray, storage: np.dtype) -> jnp.ndarray:
 
 
 def _from_bytes(b: jnp.ndarray, storage: np.dtype) -> jnp.ndarray:
-    """uint8 [n, itemsize] → [n] of storage dtype (f64: staged uint32 [n,2])."""
+    """uint8 [n, itemsize] → [n] payload (f64: uint32 [n,2] bit pairs)."""
     if _is_f64(storage):
         return jax.lax.bitcast_convert_type(b.reshape(-1, 2, 4), jnp.uint32)
     if storage.itemsize == 1:
@@ -122,58 +139,120 @@ def _from_bytes_dt(b: jnp.ndarray, dt) -> jnp.ndarray:
     return _from_bytes(b, dt.storage)
 
 
-def _stage(col: Column) -> jnp.ndarray:
-    """Payload handed to the jit cores; f64 becomes uint32 [n, 2] halves."""
-    if col.dtype.is_fixed_width and _is_f64(col.dtype.storage):
-        return jnp.asarray(
-            np.ascontiguousarray(np.asarray(col.data)).view(np.uint32).reshape(-1, 2))
-    return col.data
+# ---------------------------------------------------------------------------
+# fixed-width core: [cols…] → uint32 row words [n * W]
+# ---------------------------------------------------------------------------
+
+# Row-word counts up to which the layout-preserving 3-D permute beats one
+# big 2-D transpose, per direction.  Measured on the target chip
+# (tools/profile_transcode.py + crossover sweep, round 3):
+#   interleave  perm3/transpose GB/s — W=11: 343/136, W=24: 747/263,
+#                                      W=40: 351/323, W=53: 154/375
+#   deinterleave                      — W=11: 286/51,  W=24: 469/101,
+#                                      W=32: 145/254, W=53: 154/372
+_IL_PERM3_MAX_W = 40
+_DL_PERM3_MAX_W = 24
 
 
-def _unstage(data: jnp.ndarray, storage: np.dtype) -> jnp.ndarray:
+def _interleave_words(words: list[jnp.ndarray], W: int) -> jnp.ndarray:
+    """[W] u32 vectors of [n_pad] (n_pad % 128 == 0) → flat JCUDF word
+    stream u32 [n_pad * W] with out[r*W + w] = words[w][r]."""
+    x = jnp.stack(words, axis=0)                        # [W, n_pad]
+    n_pad = x.shape[1]
+    if W <= _IL_PERM3_MAX_W:
+        # layout-preserving permute: every reshape boundary is a 128-lane
+        # multiple, so XLA never materializes a padded-minor temporary
+        return x.reshape(W, n_pad // 128, 128).transpose(1, 2, 0).reshape(-1)
+    return x.T.reshape(-1)
+
+
+def _deinterleave_words(flat: jnp.ndarray, W: int) -> jnp.ndarray:
+    """Inverse of :func:`_interleave_words`: u32 [n_pad*W] → [W, n_pad]."""
+    if W <= _DL_PERM3_MAX_W:
+        return flat.reshape(-1, 128, W).transpose(2, 0, 1).reshape(W, -1)
+    return flat.reshape(-1, W).T
+
+
+@jax.jit
+def _words_to_bytes(w: jnp.ndarray) -> jnp.ndarray:
+    """u32 [N] → u8 [4N] little-endian (byte-boundary use only)."""
+    return jax.lax.bitcast_convert_type(w, jnp.uint8).reshape(-1)
+
+
+@jax.jit
+def _bytes_to_words(b: jnp.ndarray) -> jnp.ndarray:
+    """u8 [4N] → u32 [N] little-endian."""
+    return jax.lax.bitcast_convert_type(b.reshape(-1, 4), jnp.uint32)
+
+
+def _word_plan(layout: RowLayout):
+    """For each u32 word of the row, the static list of fragments.
+
+    Fragment = (input_index, kind, arg):
+      kind 'full'  — input is u32 [n], the whole word                (size 4)
+      kind 'pair'  — input is u32 [n, k], arg selects the lane      (size 8/16)
+      kind 'sub'   — input is zero-extended u32 [n], arg = byte shift (size <4)
+      kind 'vbyte' — input is the validity byte k, arg = (k, shift)
+    Input order: one staged array per column, then the validity bytes.
+    Every fixed slot is aligned to its own size (compute_column_information,
+    ``row_conversion.cu:1331-1370``), so fragments never straddle words.
+    """
+    W = layout.fixed_row_size // 4
+    plan: list[list[tuple[int, str, object]]] = [[] for _ in range(W)]
+    for ci, dt in enumerate(layout.schema):
+        start = layout.column_starts[ci]
+        size = layout.column_sizes[ci]
+        if size == 16:   # DECIMAL128: staged u32 [n, 4], four words
+            for j in range(4):
+                plan[start // 4 + j].append((ci, "pair", j))
+        elif size == 8:
+            plan[start // 4].append((ci, "pair", 0))
+            plan[start // 4 + 1].append((ci, "pair", 1))
+        elif size == 4:
+            plan[start // 4].append((ci, "full", None))
+        else:  # 1 or 2; alignment keeps it inside one word
+            plan[start // 4].append((ci, "sub", start % 4))
+    vi = layout.num_columns
+    vo = layout.validity_offset
+    for k in range(layout.validity_bytes):
+        byte = vo + k
+        plan[byte // 4].append((vi, "vbyte", (k, byte % 4)))
+    return plan
+
+
+def _stage_column(data: jnp.ndarray, storage: np.dtype) -> jnp.ndarray:
+    """Column payload → u32 staged form for the word plan: 8-byte columns as
+    u32 [n, 2] halves, 4-byte bitcast, sub-word zero-extended.  FLOAT64 is
+    already stored as u32 [n, 2] bit pairs (Column invariant)."""
     if _is_f64(storage):
-        return jnp.asarray(
-            np.ascontiguousarray(np.asarray(data)).view(np.float64).reshape(-1))
-    return data
+        return data
+    data = data.astype(storage)
+    if storage.itemsize == 8:
+        return jax.lax.bitcast_convert_type(data, jnp.uint32)   # [n, 2]
+    if storage.itemsize == 4:
+        return jax.lax.bitcast_convert_type(data, jnp.uint32)   # [n]
+    unsigned = np.dtype(f"u{storage.itemsize}")
+    return jax.lax.bitcast_convert_type(data, unsigned).astype(jnp.uint32)
 
 
-def _unstage_dt(data: jnp.ndarray, dt) -> jnp.ndarray:
+def _stage_column_dt(data: jnp.ndarray, dt) -> jnp.ndarray:
+    """DType-aware staging: DECIMAL128 [n, 2] int64 lanes → u32 [n, 4]."""
     if dt.id == T.TypeId.DECIMAL128:
-        return data               # [n, 2] int64 lanes ARE the payload
-    return _unstage(data, dt.storage)
+        return jax.lax.bitcast_convert_type(
+            data, jnp.uint32).reshape(data.shape[0], 4)
+    return _stage_column(data, dt.storage)
 
 
-# ---------------------------------------------------------------------------
-# fixed-width core: [cols…] → uint8 [n, fixed_row_size]
-# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnums=0)
+def _to_rows_fixed_words(layout: RowLayout, datas: tuple[jnp.ndarray, ...],
+                         valid: jnp.ndarray) -> jnp.ndarray:
+    """Fixed-width columns + validity matrix → flat u32 row words [n*W].
 
-def _to_rows_fixed(layout: RowLayout, datas: tuple[jnp.ndarray, ...],
-                   valid: jnp.ndarray, use_pallas: bool | None = None):
-    """Dispatching wrapper: the Pallas-vs-XLA choice is part of the jit cache
-    key (static arg), so toggling ``SRJT_PALLAS`` at runtime takes effect for
-    shapes that were already traced.  ``None`` reads the env now — callers
-    tracing this inside their own jit inherit trace-time semantics."""
-    from . import pallas_kernels
-    if use_pallas is None:
-        use_pallas = pallas_kernels.fixed_pallas_enabled()
-    use_pallas = use_pallas and pallas_kernels.layout_supported(layout)
-    return _to_rows_fixed_impl(layout, bool(use_pallas), tuple(datas), valid)
-
-
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def _to_rows_fixed_impl(layout: RowLayout, use_pallas: bool,
-                        datas: tuple[jnp.ndarray, ...],
-                        valid: jnp.ndarray) -> jnp.ndarray:
-    if use_pallas:
-        from . import pallas_kernels
-        return pallas_kernels.to_rows_fixed(layout, tuple(datas), valid)
-    # Wide formulation (mirror of _from_rows_fixed_impl): compose each row
-    # word as a [n]-long u32 vector from statically-planned column
-    # fragments, then interleave with wide-minor strided lane writes —
-    # per-column u8 slice writes into [n, row_size] force padded small-
-    # minor layouts on TPU.
-    from . import pallas_kernels as pk
-    from . import ragged
+    Compose each row word as a [n]-long u32 vector from statically-planned
+    column fragments (one shift/or tree per word — the data transpose and
+    the warp-ballot validity pack of ``row_conversion.cu:575-810`` fused
+    into one pass), then interleave with a single layout-preserving permute.
+    """
     n = valid.shape[0]
     W = layout.fixed_row_size // 4
     n_pad = -(-n // 128) * 128
@@ -181,7 +260,7 @@ def _to_rows_fixed_impl(layout: RowLayout, use_pallas: bool,
     def padrows(x):
         return jnp.pad(x, [(0, n_pad - n)] + [(0, 0)] * (x.ndim - 1))
 
-    staged = [padrows(pk._stage_column_dt(d, dt))
+    staged = [padrows(_stage_column_dt(d, dt))
               for d, dt in zip(datas, layout.schema)]
     vbytes_w = []
     for k in range(layout.validity_bytes):
@@ -191,7 +270,7 @@ def _to_rows_fixed_impl(layout: RowLayout, use_pallas: bool,
                          << jnp.uint32(i))
         vbytes_w.append(padrows(acc))
 
-    plan = pk._word_plan(layout)
+    plan = _word_plan(layout)
     words = []
     for w in range(W):
         acc = None
@@ -210,63 +289,22 @@ def _to_rows_fixed_impl(layout: RowLayout, use_pallas: bool,
             acc = v if acc is None else acc | v
         words.append(acc if acc is not None
                      else jnp.zeros((n_pad,), jnp.uint32))
-    if W <= _W_STRIDED_MAX:
-        # narrow: W strided lane writes into a wide-minor buffer
-        out2 = jnp.zeros((n_pad // 128, 128 * W), dtype=jnp.uint32)
-        for w in range(W):
-            out2 = out2.at[:, w::W].set(words[w].reshape(n_pad // 128, 128))
-        flat_w = out2.reshape(-1)
-    else:
-        # wide: strided writes cost W passes (O(W²) traffic at 212 cols);
-        # one [W, n]→[n, W] transpose is a single pass and [n, W]'s minor
-        # padding to the 128-lane tile is ≤ ~2× for W > 48
-        flat_w = jnp.stack(words, axis=0).T.reshape(-1)
-    return ragged.u32_to_u8(flat_w).reshape(
-        n_pad, layout.fixed_row_size)[:n]
+    flat = _interleave_words(words, W)
+    return flat[:n * W] if n_pad != n else flat
 
 
-def _from_rows_fixed(layout: RowLayout, rows: jnp.ndarray,
-                     use_pallas: bool | None = None):
-    """uint8 [n, fixed_row_size] → (datas tuple, valid bool [n, ncols]).
-
-    Same dispatch contract as :func:`_to_rows_fixed`."""
-    from . import pallas_kernels
-    if use_pallas is None:
-        use_pallas = pallas_kernels.fixed_pallas_enabled()
-    use_pallas = use_pallas and pallas_kernels.layout_supported(layout)
-    return _from_rows_fixed_impl(layout, bool(use_pallas), rows)
-
-
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def _from_rows_fixed_impl(layout: RowLayout, use_pallas: bool,
-                          rows: jnp.ndarray):
-    if use_pallas:
-        from . import pallas_kernels
-        return pallas_kernels.from_rows_fixed(layout, rows)
-    # Wide formulation: deinterleave the row words into [n]-long vectors
-    # with wide-minor strided slices, then extract columns with shifts —
-    # per-column narrow u8 slices of the [n, row_size] matrix force padded
-    # (…,small)-minor layouts on TPU and ran ~50× slower at 212 columns.
-    from . import ragged
-    n = rows.shape[0]
-    R = layout.fixed_row_size
-    W = R // 4
+@functools.partial(jax.jit, static_argnums=0)
+def _from_rows_fixed_words(layout: RowLayout, flat: jnp.ndarray):
+    """Flat u32 row words [n*W] → (datas tuple, valid bool [n, ncols])."""
+    W = layout.fixed_row_size // 4
+    n = flat.shape[0] // W
     n_pad = -(-n // 128) * 128
-    rows_p = jnp.pad(rows, ((0, n_pad - n), (0, 0)))
-    w32 = ragged.u8_to_u32(rows_p.reshape(-1))           # [n_pad*W]
-    if W <= _W_STRIDED_MAX:
-        x2 = w32.reshape(n_pad // 128, 128 * W)
+    if n_pad != n:
+        flat = jnp.pad(flat, (0, (n_pad - n) * W))
+    t2 = _deinterleave_words(flat, W)                    # [W, n_pad]
 
-        def word(w):
-            return x2[:, w::W].reshape(-1)               # [n_pad]
-    else:
-        # wide: one transpose instead of W strided slices (see
-        # _to_rows_fixed_impl); sublane rows of the transposed matrix are
-        # cheap to read
-        t2 = w32.reshape(n_pad, W).T                     # [W, n_pad]
-
-        def word(w):
-            return t2[w]
+    def word(w):
+        return t2[w]
 
     datas = []
     for ci, dt in enumerate(layout.schema):
@@ -283,7 +321,7 @@ def _from_rows_fixed_impl(layout: RowLayout, use_pallas: bool,
             pair = jnp.stack([word(start // 4), word(start // 4 + 1)],
                              axis=1)[:n]
             if _is_f64(st):
-                datas.append(pair)                       # staged convention
+                datas.append(pair)           # u32 [n, 2] IS the f64 storage
             else:
                 datas.append(jax.lax.bitcast_convert_type(pair,
                                                           jnp.dtype(st)))
@@ -310,35 +348,31 @@ def _from_rows_fixed_impl(layout: RowLayout, use_pallas: bool,
 # around the reference's kernels is host code (offset columns built with
 # Thrust + D2D copies, row_conversion.cu:1460-1539); on a remote-dispatch TPU
 # that host work (and its H2D offset upload) dominates, so the full call —
-# validity-matrix build, byte transpose, offsets arange — is one jit program
-# and the only transfer is the column payloads already resident in HBM.
+# validity-matrix build, word compose, interleave, offsets arange — is one
+# jit program and the only transfer is the column payloads already in HBM.
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+@functools.partial(jax.jit, static_argnums=(0, 1))
 def _to_rows_fixed_full(layout: RowLayout, has_valid: tuple[bool, ...],
-                        use_pallas: bool,
                         datas: tuple[jnp.ndarray, ...],
                         valids: tuple[jnp.ndarray, ...]):
-    """Fixed-width table → (flat row bytes, int32 row offsets), one dispatch.
-
-    ``valids`` carries arrays only for columns where ``has_valid`` is True;
-    all-valid columns get their ones generated (and fused away) on device.
-    """
+    """Fixed-width table → (flat u32 row words, int32 row offsets), one
+    dispatch.  ``valids`` carries arrays only for columns where ``has_valid``
+    is True; all-valid columns get their ones generated (and fused away)
+    on device."""
     n = datas[0].shape[0]
     vi = iter(valids)
     cols_valid = [next(vi) if hv else jnp.ones((n,), dtype=jnp.bool_)
                   for hv in has_valid]
     valid = jnp.stack(cols_valid, axis=1)
-    rows2d = _to_rows_fixed(layout, datas, valid, use_pallas)
+    flat = _to_rows_fixed_words(layout, datas, valid)
     offsets = jnp.arange(n + 1, dtype=jnp.int32) * layout.fixed_row_size
-    return rows2d.reshape(-1), offsets
+    return flat, offsets
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def _from_rows_fixed_full(layout: RowLayout, use_pallas: bool,
-                          data: jnp.ndarray):
-    """Flat row bytes → (datas, per-column validity vectors), one dispatch."""
-    rows2d = data.reshape(-1, layout.fixed_row_size)
-    datas, valid = _from_rows_fixed(layout, rows2d, use_pallas)
+@functools.partial(jax.jit, static_argnums=0)
+def _from_rows_fixed_full(layout: RowLayout, words: jnp.ndarray):
+    """Flat u32 row words → (datas, per-column validity vectors)."""
+    datas, valid = _from_rows_fixed_words(layout, words)
     valids = tuple(valid[:, ci] for ci in range(layout.num_columns))
     return datas, valids
 
@@ -440,7 +474,7 @@ def _to_rows_var_dma(layout: RowLayout, sub: "Table", valid: jnp.ndarray,
     fixed2d = _var_fixed_region(
         layout,
         tuple(jnp.zeros(0, jnp.uint8) if c.dtype.is_variable_width
-              else _stage(c) for c in sub.columns),
+              else c.data for c in sub.columns),
         tuple(sub[ci].offsets for ci in var_idx), valid)
 
     total_chars = int(lens_np.sum())
@@ -707,15 +741,12 @@ def convert_to_rows(table: Table,
         boundaries.append(n)
         out = []
         has_valid = tuple(c.validity is not None for c in table.columns)
-        from . import pallas_kernels
-        use_pallas = (pallas_kernels.fixed_pallas_enabled()  # outside jit
-                      and pallas_kernels.layout_supported(layout))
         for lo, hi in zip(boundaries[:-1], boundaries[1:]):
             cols = (table.columns if (lo, hi) == (0, n)
                     else [_slice_column(c, lo, hi) for c in table.columns])
             data, offsets = _to_rows_fixed_full(
-                layout, has_valid, use_pallas,
-                tuple(_stage(c) for c in cols),
+                layout, has_valid,
+                tuple(c.data for c in cols),
                 tuple(c.validity for c in cols if c.validity is not None))
             out.append(RowBatch(data, offsets))
         return out
@@ -746,7 +777,7 @@ def convert_to_rows(table: Table,
                 batches.row_offsets_within_batch[bi].astype(np.int64))
             data = _to_rows_var(
                 layout, batches.batch_bytes[bi],
-                tuple(_stage(c) for c in sub.columns),
+                tuple(c.data for c in sub.columns),
                 # _slice_column already rebases string offsets to zero
                 tuple(sub[ci].offsets
                       for ci in layout.variable_column_indices),
@@ -778,21 +809,19 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
     n = batch.num_rows
 
     if layout.fixed_width_only:
-        if batch.data.shape[0] != n * layout.fixed_row_size:
+        if batch.num_bytes != n * layout.fixed_row_size:
             raise ValueError(
-                f"row data holds {batch.data.shape[0]} bytes but offsets "
+                f"row data holds {batch.num_bytes} bytes but offsets "
                 f"describe {n} rows of {layout.fixed_row_size} bytes")
-        from . import pallas_kernels
-        datas, valids = _from_rows_fixed_full(
-            layout,
-            (pallas_kernels.fixed_pallas_enabled()
-             and pallas_kernels.layout_supported(layout)),
-            batch.data)
-        cols = [Column(dt, _unstage_dt(datas[ci], dt), validity=valids[ci])
+        words = (batch.data if batch.data.dtype == jnp.uint32
+                 else _bytes_to_words(batch.data))
+        datas, valids = _from_rows_fixed_full(layout, words)
+        cols = [Column(dt, datas[ci], validity=valids[ci])
                 for ci, dt in enumerate(schema)]
         return Table(cols)
 
     from . import ragged
+    bdata = batch.device_u8()   # var path is byte-granular (DMA engine)
     if (ragged.dma_supported()
             and len(layout.variable_column_indices) <= _DMA_MAX_VAR_COLS):
         # DMA path (copy_strings_from_rows analog, row_conversion.cu:
@@ -803,7 +832,7 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
         # scanned char totals (row_conversion.cu:2215).
         offs_np = np.asarray(batch.offsets, dtype=np.int64)
         row_base_np = offs_np[:-1]
-        fixed_dense = ragged.unpack(batch.data, offs_np,
+        fixed_dense = ragged.unpack(bdata, offs_np,
                                     layout.fixed_plus_validity)
         datas, valid, slots = _var_fixed_extract(layout, fixed_dense)
         row_sizes_np = offs_np[1:] - offs_np[:-1]
@@ -828,7 +857,7 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
             np.cumsum(lens, out=offs[1:])
             out_offsets.append(jnp.asarray(offs))
             chars.append(ragged.copy_segments(
-                batch.data, row_base_np + s[:, 0], offs[:-1], lens,
+                bdata, row_base_np + s[:, 0], offs[:-1], lens,
                 int(offs[-1])))
         return _assemble(schema, datas, valid, tuple(chars),
                          [o.astype(jnp.int32) for o in out_offsets])
@@ -837,7 +866,7 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
 
     # strings: phase 1 — lengths; host sync for char totals (reference syncs
     # identically at row_conversion.cu:2215)
-    slots = _gather_var_slots(layout, batch.data, row_offsets)
+    slots = _gather_var_slots(layout, bdata, row_offsets)
     out_offsets = []
     char_totals = []
     for s in slots:
@@ -847,7 +876,7 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
         out_offsets.append(jnp.asarray(offs))
         char_totals.append(int(offs[-1]))
     datas, valid, chars = _from_rows_var(
-        layout, tuple(char_totals), batch.data, row_offsets,
+        layout, tuple(char_totals), bdata, row_offsets,
         tuple(out_offsets), slots)
     return _assemble(schema, datas, valid, chars,
                      [o.astype(jnp.int32) for o in out_offsets])
@@ -865,7 +894,7 @@ def _assemble(schema, datas, valid, chars, out_offsets) -> Table:
             cols.append(Column(dt, chars[vi], out_offsets[vi], v))
             vi += 1
         else:
-            cols.append(Column(dt, _unstage_dt(datas[ci], dt), validity=v))
+            cols.append(Column(dt, datas[ci], validity=v))
     return Table(cols)
 
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import types as T
 from ..column import Table
 from .layout import compute_row_layout
 from .reference import _col_valid
@@ -34,8 +35,9 @@ def to_rows_fixed_np(table: Table) -> np.ndarray:
     for ci, col in enumerate(table.columns):
         start = layout.column_starts[ci]
         sz = layout.column_sizes[ci]
-        data = np.ascontiguousarray(np.asarray(col.data),
-                                    dtype=col.dtype.storage)
+        # Column payloads are already in storage form (f64 = u32 bit pairs,
+        # decimal128 = int64 lane pairs), so a raw byte view is exact.
+        data = np.ascontiguousarray(np.asarray(col.data))
         out[:, start:start + sz] = data.view(np.uint8).reshape(n, sz)
     valid = _valid_matrix(table)
     vbytes = np.packbits(valid, axis=1, bitorder="little")
@@ -54,7 +56,10 @@ def from_rows_fixed_np(rows: np.ndarray, schema) -> tuple[list, np.ndarray]:
         start = layout.column_starts[ci]
         sz = layout.column_sizes[ci]
         b = np.ascontiguousarray(rows[:, start:start + sz])
-        datas.append(b.view(dt.storage).reshape(n))
+        if dt.id == T.TypeId.FLOAT64:    # storage form: u32 [n, 2] bit pairs
+            datas.append(b.view(np.uint32).reshape(n, 2))
+        else:
+            datas.append(b.view(dt.storage).reshape(n))
     vb = rows[:, layout.validity_offset:
               layout.validity_offset + layout.validity_bytes]
     valid = np.unpackbits(np.ascontiguousarray(vb), axis=1,

@@ -72,8 +72,11 @@ def _host_cols(table: Table):
                                               dtype=np.uint8))
             offs.append(_i32(np.asarray(col.offsets)))
         else:
+            # Payloads are already in storage form (FLOAT64 = u32 [n,2] bit
+            # pairs, DECIMAL128 = i64 lane pairs): a raw byte view is exact,
+            # while a dtype= conversion would VALUE-cast f64 bit halves.
             datas.append(np.ascontiguousarray(
-                np.asarray(col.data), dtype=col.dtype.storage).view(np.uint8))
+                np.asarray(col.data)).view(np.uint8).reshape(-1))
             offs.append(None)
         valids.append(None if col.validity is None else
                       np.ascontiguousarray(np.asarray(col.validity),

@@ -42,6 +42,14 @@ def to_rows_np(table: Table) -> tuple[np.ndarray, np.ndarray]:
     np.cumsum(row_sizes, out=row_offsets[1:])
     out = np.zeros(int(row_offsets[-1]), dtype=np.uint8)
 
+    # Hoist every device payload to host ONCE: per-row ``np.asarray`` on a
+    # device array is a full tunnel round-trip (~65-110 ms) on the remote
+    # TPU backend — n*cols of them turned this oracle into hours.
+    host_data = [np.asarray(c.data) for c in table.columns]
+    host_offs = [None if c.offsets is None else np.asarray(c.offsets)
+                 for c in table.columns]
+    host_valid = [_col_valid(c) for c in table.columns]
+
     for r in range(n):
         base = int(row_offsets[r])
         # fixed-width slots + string (offset, len) slots
@@ -49,18 +57,23 @@ def to_rows_np(table: Table) -> tuple[np.ndarray, np.ndarray]:
         for ci, col in enumerate(table.columns):
             start = base + layout.column_starts[ci]
             if col.dtype.is_variable_width:
-                offs = np.asarray(col.offsets)
+                offs = host_offs[ci]
                 length = int(offs[r + 1] - offs[r])
                 slot = np.asarray([var_cursor, length], dtype=np.uint32)
                 out[start:start + 8] = slot.view(np.uint8)
-                chars = np.asarray(col.data)[offs[r]:offs[r + 1]]
+                chars = host_data[ci][offs[r]:offs[r + 1]]
                 out[base + var_cursor:base + var_cursor + length] = chars
                 var_cursor += length
             elif col.dtype.id.name == "DECIMAL128":
-                lanes = np.asarray(col.data[r], dtype=np.int64)  # (lo, hi)
+                lanes = np.ascontiguousarray(host_data[ci][r], dtype=np.int64)  # (lo, hi)
                 out[start:start + 16] = lanes.view(np.uint8)
+            elif col.dtype.id == T.TypeId.FLOAT64:
+                # storage is the u32 [n, 2] bit pattern (column.py invariant)
+                halves = np.ascontiguousarray(host_data[ci][r], dtype=np.uint32)
+                out[start:start + 8] = halves.view(np.uint8)
             else:
-                val = np.asarray(col.data[r:r + 1], dtype=col.dtype.storage)
+                val = np.ascontiguousarray(host_data[ci][r:r + 1],
+                                       dtype=col.dtype.storage)
                 sz = layout.column_sizes[ci]
                 out[start:start + sz] = val.view(np.uint8)
         # validity bytes, bit i of byte b = column b*8+i (RowConversion.java:56-58)
@@ -68,7 +81,7 @@ def to_rows_np(table: Table) -> tuple[np.ndarray, np.ndarray]:
         for b in range(layout.validity_bytes):
             byte = 0
             for i in range(min(8, table.num_columns - b * 8)):
-                if _col_valid(table[b * 8 + i])[r]:
+                if host_valid[b * 8 + i][r]:
                     byte |= 1 << i
             out[vbase + b] = byte
 
@@ -90,6 +103,8 @@ def from_rows_np(row_bytes: np.ndarray, row_offsets: np.ndarray,
             datas.append([])  # list of per-row bytes
         elif dt.id == T.TypeId.DECIMAL128:
             datas.append(np.zeros((n, 2), dtype=np.int64))
+        elif dt.id == T.TypeId.FLOAT64:
+            datas.append(np.zeros((n, 2), dtype=np.uint32))  # bit pairs
         else:
             datas.append(np.zeros(n, dtype=dt.storage))
 
@@ -106,6 +121,8 @@ def from_rows_np(row_bytes: np.ndarray, row_offsets: np.ndarray,
                 datas[ci].append(row_bytes[base + off:base + off + length])
             elif dt.id == T.TypeId.DECIMAL128:
                 datas[ci][r] = row_bytes[start:start + 16].view(np.int64)
+            elif dt.id == T.TypeId.FLOAT64:
+                datas[ci][r] = row_bytes[start:start + 8].view(np.uint32)
             else:
                 sz = layout.column_sizes[ci]
                 datas[ci][r] = row_bytes[start:start + sz].view(dt.storage)[0]
@@ -123,7 +140,7 @@ def from_rows_np(row_bytes: np.ndarray, row_offsets: np.ndarray,
             import jax.numpy as jnp
             cols.append(Column(dt, jnp.asarray(chars), jnp.asarray(offs),
                                None if v is None else jnp.asarray(v)))
-        elif dt.id == T.TypeId.DECIMAL128:
+        elif dt.id in (T.TypeId.DECIMAL128, T.TypeId.FLOAT64):
             import jax.numpy as jnp
             cols.append(Column(dt, jnp.asarray(datas[ci]),
                                validity=None if v is None

@@ -94,7 +94,7 @@ class TestScan:
         vals = np.asarray([1.5, 2.5, -1.0], np.float32)
         got = cumulative_sum(Column.from_numpy(vals))
         assert got.dtype == T.float64
-        np.testing.assert_allclose(np.asarray(got.data), [1.5, 4.0, 3.0])
+        np.testing.assert_allclose(got.to_numpy(), [1.5, 4.0, 3.0])
 
     def test_cummin_cummax(self):
         rng = np.random.default_rng(8)

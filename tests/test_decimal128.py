@@ -165,7 +165,7 @@ class TestCasts:
 
     def test_to_float64(self):
         col = d128.from_pyints([12345, -67890, 2**70], scale=-2)
-        got = np.asarray(cast(col, T.float64).data)
+        got = cast(col, T.float64).to_numpy()
         want = np.asarray([123.45, -678.90, float(2**70) * 1e-2])
         np.testing.assert_allclose(got, want, rtol=1e-12)
 
@@ -246,7 +246,7 @@ class TestJcudfRows:
         t = self._table()
         batches = convert_to_rows(t)
         ob, _ = ref.to_rows_np(t)
-        np.testing.assert_array_equal(np.asarray(batches[0].data), ob)
+        np.testing.assert_array_equal(batches[0].host_bytes(), ob)
         back = convert_from_rows(batches[0], t.schema)
         assert back[1].dtype == T.decimal128(-2)
         assert back[1].to_pylist() == t[1].to_pylist()
@@ -259,7 +259,7 @@ class TestJcudfRows:
         t = self._table(101, seed=5, with_strings=True)
         batches = convert_to_rows(t)
         ob, _ = ref.to_rows_np(t)
-        np.testing.assert_array_equal(np.asarray(batches[0].data), ob)
+        np.testing.assert_array_equal(batches[0].host_bytes(), ob)
         back = convert_from_rows(batches[0], t.schema)
         for i in range(t.num_columns):
             assert back[i].to_pylist() == t[i].to_pylist(), i

@@ -63,7 +63,7 @@ def test_etl_matches_pandas(files, dfs):
     np.testing.assert_array_equal(np.asarray(cols["max_delinquency"].data),
                                   exp.max_delinq.to_numpy().astype(np.int64))
     # mean UPB skips blank (null) rows — pandas mean(skipna) is the oracle
-    np.testing.assert_allclose(np.asarray(cols["mean_upb"].data),
+    np.testing.assert_allclose(cols["mean_upb"].to_numpy(),
                                exp.mean_upb.to_numpy(), rtol=1e-9)
     np.testing.assert_array_equal(np.asarray(cols["num_records"].data),
                                   exp.cnt.to_numpy().astype(np.int64))

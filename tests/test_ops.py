@@ -297,9 +297,9 @@ class TestGroupbyVarStd:
         out = ops.groupby_aggregate(t, [0], [(1, "var"), (1, "std")])
         df = pd.DataFrame({"k": keys, "v": np.where(valid, vals, np.nan)})
         exp = df.groupby("k")["v"].agg(["var", "std"]).reset_index()
-        np.testing.assert_allclose(np.asarray(out[1].data),
+        np.testing.assert_allclose(out[1].to_numpy(),
                                    exp["var"].to_numpy(), rtol=1e-9)
-        np.testing.assert_allclose(np.asarray(out[2].data),
+        np.testing.assert_allclose(out[2].to_numpy(),
                                    exp["std"].to_numpy(), rtol=1e-9)
 
     def test_var_single_row_group_is_null(self):
@@ -325,7 +325,7 @@ class TestGroupbyNullKeys:
         t = Table([Column.from_numpy(np.ones(3, np.int32)),
                    Column.from_numpy(vals)])
         out = ops.groupby_aggregate(t, [0], [(1, "var")])
-        np.testing.assert_allclose(np.asarray(out[1].data), [1.0], rtol=1e-9)
+        np.testing.assert_allclose(out[1].to_numpy(), [1.0], rtol=1e-9)
 
 
 class TestDecimalStatistics:
@@ -335,8 +335,8 @@ class TestDecimalStatistics:
                    Column.from_numpy(np.asarray([100, 300], np.int64),
                                      sr.decimal64(-2))])
         out = ops.groupby_aggregate(t, [0], [(1, "var"), (1, "mean")])
-        np.testing.assert_allclose(np.asarray(out[1].data), [2.0])
-        np.testing.assert_allclose(np.asarray(out[2].data), [2.0])
+        np.testing.assert_allclose(out[1].to_numpy(), [2.0])
+        np.testing.assert_allclose(out[2].to_numpy(), [2.0])
 
 
 class TestFirstLastNunique:
@@ -379,3 +379,44 @@ class TestFirstLastNunique:
         assert out[1].to_pylist() == [1]
         with pytest.raises(NotImplementedError):
             ops.groupby_aggregate(t, [0], [(1, "first")])
+
+
+class TestFloat64BitStorage:
+    """FLOAT64 columns store u32 [n,2] bit pairs (round-3 invariant) —
+    Spark-semantics regressions found in the round-3 review."""
+
+    def test_groupby_negzero_and_nan_keys_collapse(self):
+        # Spark grouping: -0.0 == 0.0 and all NaNs are one group
+        keys = np.asarray([0.0, -0.0, np.nan, np.nan, 1.0], np.float64)
+        t = Table([Column.from_numpy(keys),
+                   Column.from_numpy(np.ones(5, np.int64))])
+        out = ops.groupby_aggregate(t, [0], [(1, "count")])
+        assert out.num_rows == 3  # {0.0, 1.0, NaN}
+        counts = sorted(out[1].to_pylist())
+        assert counts == [1, 2, 2]
+
+    def test_sort_negative_nan_is_largest(self):
+        neg_nan = np.frombuffer(
+            np.uint64(0xFFF8000000000001).tobytes(), np.float64)[0]
+        vals = np.asarray([1.0, neg_nan, -np.inf, np.inf, -1.0], np.float64)
+        t = Table([Column.from_numpy(vals)])
+        asc = ops.sort_table(t, [0])[0].to_numpy()
+        assert np.isnan(asc[-1]) and asc[0] == -np.inf
+        desc = ops.sort_table(t, [0], ascending=[False])[0].to_numpy()
+        assert np.isnan(desc[0]) and desc[-1] == -np.inf
+
+    def test_scan_result_respects_invariant(self):
+        col = Column.from_numpy(np.asarray([1.5, 2.5, 3.0], np.float64))
+        out = ops.cumulative_sum(Table([col])[0])
+        assert out.data.ndim == 2 and str(out.data.dtype) == "uint32"
+        np.testing.assert_allclose(out.to_numpy(), [1.5, 4.0, 7.0])
+
+    def test_native_pack_f64_bytes_exact(self):
+        from spark_rapids_jni_tpu.rowconv import native as cpp, reference as ref
+        if not cpp.available():
+            import pytest
+            pytest.skip("native engine unavailable")
+        t = Table([Column.from_numpy(np.asarray([1.5, -0.0, 3e300]))])
+        cb, co = cpp.to_rows_np(t)
+        ob, oo = ref.to_rows_np(t)
+        np.testing.assert_array_equal(cb, ob)

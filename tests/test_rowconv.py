@@ -77,7 +77,7 @@ def roundtrip_and_differential(table: Table):
     """JAX path bytes == NumPy oracle bytes, and round-trip == identity."""
     batches = convert_to_rows(table)
     oracle_bytes, oracle_offsets = ref.to_rows_np(table)
-    got = np.concatenate([np.asarray(b.data) for b in batches])
+    got = np.concatenate([b.host_bytes() for b in batches])
     np.testing.assert_array_equal(got, oracle_bytes)
 
     assert len(batches) == 1
@@ -143,7 +143,7 @@ def test_multi_batch_splitting():
     batches = convert_to_rows(t, max_batch_bytes=1024)
     assert len(batches) > 1
     oracle_bytes, _ = ref.to_rows_np(t)
-    got = np.concatenate([np.asarray(b.data) for b in batches])
+    got = np.concatenate([b.host_bytes() for b in batches])
     np.testing.assert_array_equal(got, oracle_bytes)
     # each batch independently converts back; rows concatenate in order
     lay_rows = []
@@ -201,30 +201,19 @@ def test_zero_row_roundtrip():
     assert back.num_rows == 0
 
 
-def test_pallas_toggle_not_baked_into_jit_cache(monkeypatch):
-    # Round-1 advisor finding: the Pallas-vs-XLA choice was read at trace
-    # time inside the jitted cores, so flipping SRJT_PALLAS had no effect on
-    # shapes already traced.  The choice is now a static jit argument read
-    # per call: with the same shapes, a flipped decision must reach the
-    # Pallas entry point.
-    from spark_rapids_jni_tpu.rowconv import convert as cv
-    from spark_rapids_jni_tpu.rowconv import pallas_kernels as pk
-
-    t = Table([Column.from_numpy(np.arange(64, dtype=np.int32))])
-
-    monkeypatch.setattr(pk, "fixed_pallas_enabled", lambda: False)
-    convert_to_rows(t)  # traces the XLA variant for these shapes
-
-    seen = {}
-
-    def sentinel(layout, datas, valid):
-        seen["hit"] = True
-        raise RuntimeError("pallas sentinel")
-
-    monkeypatch.setattr(pk, "fixed_pallas_enabled", lambda: True)
-    monkeypatch.setattr(pk, "to_rows_fixed", sentinel)
-    try:
-        convert_to_rows(t)
-    except Exception:
-        pass
-    assert seen.get("hit"), "flipped dispatch never reached the Pallas path"
+def test_fixed_batches_are_u32_words():
+    # Fixed-width batches carry the JCUDF byte stream as u32 words (rows are
+    # 8-byte aligned, so the view is exact); host_bytes() is the canonical
+    # byte materialization and must match the scalar oracle.
+    import jax.numpy as jnp
+    t = Table([Column.from_numpy(np.arange(100, dtype=np.int32)),
+               Column.from_numpy(np.arange(100, dtype=np.int16))])
+    b = convert_to_rows(t)[0]
+    assert b.data.dtype == jnp.uint32
+    ob, _ = ref.to_rows_np(t)
+    np.testing.assert_array_equal(b.host_bytes(), ob)
+    # from_rows accepts the byte view of the same batch too
+    from spark_rapids_jni_tpu.rowconv.convert import RowBatch
+    back = convert_from_rows(RowBatch(b.device_u8(), b.offsets), t.schema)
+    for a, c in zip(back.columns, t.columns):
+        np.testing.assert_array_equal(np.asarray(a.data), np.asarray(c.data))

@@ -68,7 +68,7 @@ def test_fixed_pack_matches_oracle_and_device():
     np.testing.assert_array_equal(co, oo)
     dev = convert_to_rows(t)
     assert len(dev) == 1
-    np.testing.assert_array_equal(np.asarray(dev[0].data), cb)
+    np.testing.assert_array_equal(dev[0].host_bytes(), cb)
 
 
 def test_fixed_unpack_roundtrip():
@@ -90,7 +90,7 @@ def test_string_pack_matches_oracle_and_device():
     np.testing.assert_array_equal(cb, ob)
     np.testing.assert_array_equal(co, oo)
     dev = convert_to_rows(t)
-    np.testing.assert_array_equal(np.asarray(dev[0].data), cb)
+    np.testing.assert_array_equal(dev[0].host_bytes(), cb)
 
 
 def test_string_unpack_roundtrip():

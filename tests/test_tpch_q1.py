@@ -50,11 +50,11 @@ def test_q1_matches_exact_oracle(data):
     assert out[5].dtype == T.decimal128(-6)
     assert out[5].to_pylist() == g.sum_charge_u.tolist()
     # float statistics (value domain: decimals carry their scale)
-    np.testing.assert_allclose(np.asarray(out[6].data),
+    np.testing.assert_allclose(out[6].to_numpy(),
                                g.avg_qty.to_numpy(), rtol=1e-12)
-    np.testing.assert_allclose(np.asarray(out[7].data),
+    np.testing.assert_allclose(out[7].to_numpy(),
                                g.avg_price_c.to_numpy() / 100.0, rtol=1e-12)
-    np.testing.assert_allclose(np.asarray(out[8].data),
+    np.testing.assert_allclose(out[8].to_numpy(),
                                g.avg_disc_c.to_numpy() / 100.0, rtol=1e-12)
     assert out[9].to_pylist() == g.cnt.tolist()
 

@@ -8,9 +8,11 @@ chip:
 1. the ragged DMA engine (pack / unpack / segmented_copy) vs NumPy;
 2. the full string JCUDF transcode (DMA path) vs the scalar NumPy oracle
    (``rowconv/reference.py``) across schema shapes;
-3. the opt-in Pallas fixed-width kernels (SRJT_PALLAS=1 path) vs the XLA
-   path across the schema matrix (the two documented Mosaic workarounds in
-   ``pallas_kernels.py`` make this non-optional).
+3. the fixed-width u32-words transcode (round-3 permute/transpose
+   formulations) vs the oracle across the schema matrix, including FLOAT64
+   bit-pair columns and decimal128 — byte movement must be exact on chip;
+4. the arithmetic f64 bits<->values path (``utils.f64bits``) round-trips
+   normals/inf/nan exactly on the emulated-f64 backend.
 
 Usage: python tools/tpu_check.py [out.json]
 """
@@ -29,9 +31,8 @@ import jax.numpy as jnp
 import spark_rapids_jni_tpu as sr
 from spark_rapids_jni_tpu import Table, Column, convert_to_rows, convert_from_rows
 from spark_rapids_jni_tpu.rowconv import ragged, reference
-from spark_rapids_jni_tpu.rowconv import pallas_kernels as pk
-from spark_rapids_jni_tpu.rowconv.convert import _to_rows_fixed_impl
 from spark_rapids_jni_tpu.rowconv.layout import compute_row_layout
+from spark_rapids_jni_tpu.utils import f64bits
 
 RESULTS = {"backend": None, "checks": [], "ok": True}
 
@@ -89,7 +90,7 @@ def check_strings_transcode():
         b = convert_to_rows(t)
         ob, _ = reference.to_rows_np(t)
         record(f"strings to_rows oracle n={n} nulls={nulls}",
-               np.array_equal(np.asarray(b[0].data), ob))
+               np.array_equal(b[0].host_bytes(), ob))
         back = convert_from_rows(b[0], t.schema)
         ok = (back[1].to_pylist() == t[1].to_pylist()
               and back[3].to_pylist() == t[3].to_pylist()
@@ -101,38 +102,74 @@ SCHEMAS = {
     "int32_only": [sr.int32] * 3,
     "mixed_words": [sr.int32, sr.int16, sr.int8],
     "wide_mixed": [sr.int64, sr.int32, sr.int16, sr.int8, sr.float32,
-                   sr.bool8] * 2,
+                   sr.bool8, sr.float64] * 2,
     "bytes_only": [sr.int8] * 5,
     "timestamps_decimals": [sr.timestamp_ms, sr.decimal32(-2),
-                            sr.decimal64(-4), sr.bool8],
+                            sr.decimal64(-4), sr.bool8, sr.types.decimal128(-4)],
+    # wide enough to route through the 2-D-transpose interleave (W > 40)
+    "wide_176col": [sr.int64, sr.int32, sr.float64, sr.int16] * 44,
 }
 
 
-def check_pallas_fixed():
+def _random_table(rng, schema, n):
+    cols = []
+    for i, dt in enumerate(schema):
+        v = (rng.random(n) < 0.8) if i % 2 == 0 else None
+        if dt.id == sr.TypeId.DECIMAL128:
+            lanes = rng.integers(-2**62, 2**62, (n, 2), dtype=np.int64)
+            cols.append(Column(dt, jnp.asarray(lanes),
+                               validity=None if v is None else jnp.asarray(v)))
+        elif dt == sr.bool8:
+            cols.append(Column.from_numpy(
+                rng.integers(0, 2, n).astype(np.uint8), dt, v))
+        elif dt.storage.kind == "f":
+            cols.append(Column.from_numpy(
+                rng.standard_normal(n).astype(dt.storage), dt, v))
+        else:
+            info = np.iinfo(dt.storage)
+            cols.append(Column.from_numpy(
+                rng.integers(info.min // 2, info.max // 2, n,
+                             dtype=dt.storage), dt, v))
+    return Table(cols)
+
+
+def check_fixed_words():
     rng = np.random.default_rng(2)
     for name, schema in SCHEMAS.items():
-        layout = compute_row_layout(schema)
         n = 4097
-        datas, valid_cols = [], []
-        for dt in schema:
-            if dt.storage.kind == "f":
-                datas.append(jnp.asarray(
-                    rng.standard_normal(n).astype(dt.storage)))
-            else:
-                info = np.iinfo(dt.storage)
-                datas.append(jnp.asarray(rng.integers(
-                    info.min // 2, info.max // 2, n, dtype=dt.storage)))
-            valid_cols.append(rng.random(n) < 0.8)
-        valid = jnp.asarray(np.stack(valid_cols, axis=1))
-        want = np.asarray(_to_rows_fixed_impl(layout, False,
-                                              tuple(datas), valid))
-        got = np.asarray(pk.to_rows_fixed(layout, tuple(datas), valid))
-        record(f"pallas fixed to_rows {name}", np.array_equal(got, want))
-        back, v2 = pk.from_rows_fixed(layout, jnp.asarray(want))
-        ok = all(np.array_equal(np.asarray(g), np.asarray(d))
-                 for g, d in zip(back, datas))
-        ok = ok and np.array_equal(np.asarray(v2), np.asarray(valid))
-        record(f"pallas fixed from_rows {name}", ok)
+        t = _random_table(rng, schema, n)
+        b = convert_to_rows(t)
+        want, _ = reference.to_rows_np(t)
+        record(f"fixed words to_rows {name}",
+               np.array_equal(b[0].host_bytes(), want))
+        back = convert_from_rows(b[0], t.schema)
+        ok = True
+        for ca, cb in zip(back.columns, t.columns):
+            va = np.asarray(ca.validity_or_true())
+            ok = ok and np.array_equal(va, np.asarray(cb.validity_or_true()))
+            da, db = np.asarray(ca.data), np.asarray(cb.data)
+            ok = ok and np.array_equal(da[va], db[va])
+        record(f"fixed words roundtrip {name}", ok)
+
+
+def check_f64bits():
+    rng = np.random.default_rng(3)
+    vals = np.concatenate([
+        rng.standard_normal(4000),
+        rng.standard_normal(4000) * 10.0 ** rng.integers(-300, 300, 4000),
+        np.array([0.0, -0.0, 1.0, -1.0, np.inf, -np.inf, np.nan,
+                  2.0 ** -1022, 2.0 ** 1023, 1.7976931348623157e308]),
+    ]).astype(np.float64)
+    bits = vals.view(np.uint32).reshape(-1, 2)
+    dec = np.asarray(jax.jit(f64bits.from_bits)(jnp.asarray(bits)))
+    record("f64bits.from_bits exact",
+           np.array_equal(dec.view(np.uint64), vals.view(np.uint64)))
+    enc = np.asarray(jax.jit(f64bits.to_bits)(jnp.asarray(vals)))
+    # NaN canonicalizes on the arithmetic path — compare through a decode
+    nan = np.isnan(vals)
+    ok = (np.array_equal(enc[~nan], bits[~nan])
+          and np.isnan(enc[nan].view(np.float64)).all())
+    record("f64bits.to_bits exact (NaN canonical)", ok)
 
 
 def main():
@@ -146,8 +183,10 @@ def main():
         check_ragged()
         print("strings transcode:", flush=True)
         check_strings_transcode()
-        print("pallas fixed kernels (opt-in path):", flush=True)
-        check_pallas_fixed()
+        print("fixed-width u32-words transcode:", flush=True)
+        check_fixed_words()
+        print("f64 bits<->values:", flush=True)
+        check_f64bits()
     RESULTS["seconds"] = round(time.time() - t0, 1)
     out = sys.argv[1] if len(sys.argv) > 1 else "PALLAS_TPU_CHECK.json"
     with open(out, "w") as f:
