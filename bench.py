@@ -168,8 +168,13 @@ def bench_fixed(name: str, table: Table, lo: int, hi: int, results: list):
         return convert_from_rows(b, schema).columns[0].data
 
     def rt_body(tbl):
-        return convert_from_rows(convert_to_rows(tbl)[0],
-                                 schema).columns[0].data
+        b = convert_to_rows(tbl)[0]
+        # Materialize the row stream between directions: without the
+        # barrier XLA cancels from∘to (the deinterleave is the inverse
+        # permute of the interleave) and "measures" an identity.
+        from spark_rapids_jni_tpu.rowconv.convert import RowBatch
+        b = RowBatch(jax.lax.optimization_barrier(b.data), b.offsets)
+        return convert_from_rows(b, schema).columns[0].data
 
     out = {}
     for direction, body, data, nbytes in [

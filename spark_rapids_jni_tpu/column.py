@@ -118,7 +118,10 @@ class Column:
         np.cumsum(lengths, out=offsets[1:])
         chars = np.frombuffer(b"".join(payloads), dtype=np.uint8).copy()
         v = None if valid.all() else jnp.asarray(valid)
-        return Column(T.string, jnp.asarray(chars), jnp.asarray(offsets), v)
+        joffs = jnp.asarray(offsets)
+        from .utils import hostcache
+        hostcache.seed(joffs, offsets.astype(np.int64))
+        return Column(T.string, jnp.asarray(chars), joffs, v)
 
     @staticmethod
     def list_from_pylist(values, element_dtype: T.DType | None = None) -> "Column":

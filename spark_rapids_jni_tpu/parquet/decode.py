@@ -629,8 +629,11 @@ def _present_leaf_column(leaf: _Leaf, values, lens, valid) -> Column:
             full_lens = lens.astype(np.int64)
         offs = np.zeros(full_lens.shape[0] + 1, dtype=np.int32)
         np.cumsum(full_lens, out=offs[1:])
+        joffs = jnp.asarray(offs)
+        from ..utils import hostcache
+        hostcache.seed(joffs, offs.astype(np.int64))
         return Column(T.string if not dt.is_decimal else dt,
-                      jnp.asarray(values), jnp.asarray(offs), jvalid)
+                      jnp.asarray(values), joffs, jvalid)
     if valid is not None:
         full = np.zeros(nrows, dtype=values.dtype)
         full[valid] = values

@@ -431,6 +431,11 @@ def _var_fixed_region(layout: RowLayout, datas: tuple[jnp.ndarray, ...],
 # touching the full char region) lose to the single-pass XLA gather path.
 _DMA_MAX_VAR_COLS = 8
 
+# from_rows DMA geometry needs per-row (offset, len) slots on the HOST; the
+# tunnel streams D2H at single-digit MB/s, so above this row count the
+# device-side gather path (which syncs only per-column char totals) wins.
+_DMA_FROM_ROWS_MAX_N = 1 << 16
+
 
 def _to_rows_var_dma(layout: RowLayout, sub: "Table", valid: jnp.ndarray,
                      offs_np: np.ndarray) -> Optional[jnp.ndarray]:
@@ -463,8 +468,8 @@ def _to_rows_var_dma(layout: RowLayout, sub: "Table", valid: jnp.ndarray,
     M = -(-int(sizes_np.max(initial=8)) // 64) * 64
     Mc = M - fpv
 
-    col_offs_np = [np.asarray(sub[ci].offsets, dtype=np.int64)
-                   for ci in var_idx]
+    from ..utils import hostcache
+    col_offs_np = [hostcache.host_i64(sub[ci].offsets) for ci in var_idx]
     lens_np = np.stack([o[1:] - o[:-1] for o in col_offs_np], axis=1)
     prefix_np = np.cumsum(lens_np, axis=1) - lens_np
 
@@ -752,10 +757,13 @@ def convert_to_rows(table: Table,
         return out
 
     # variable-width (strings) path: row sizes are data-dependent, so the
-    # reference's scan + lower_bound batching applies as-is
+    # reference's scan + lower_bound batching applies as-is.  Offsets come
+    # through the host-mirror cache — a cold 1M-row offsets pull costs
+    # seconds through the tunnel and the arrays are host-born anyway.
+    from ..utils import hostcache
     total_lens = np.zeros(n, dtype=np.int64)
     for ci in layout.variable_column_indices:
-        offs = np.asarray(table[ci].offsets, dtype=np.int64)
+        offs = hostcache.host_i64(table[ci].offsets)
         total_lens += offs[1:] - offs[:-1]
     row_sizes = row_sizes_with_strings(layout, total_lens)
     _check_row_size(layout, row_sizes)
@@ -782,18 +790,25 @@ def convert_to_rows(table: Table,
                 tuple(sub[ci].offsets
                       for ci in layout.variable_column_indices),
                 valid, row_offs)
-        out.append(RowBatch(
-            data, jnp.asarray(batches.row_offsets_within_batch[bi])))
+        boffs_np = batches.row_offsets_within_batch[bi]
+        boffs = jnp.asarray(boffs_np)
+        hostcache.seed(boffs, np.asarray(boffs_np, dtype=np.int64))
+        out.append(RowBatch(data, boffs))
     return out
 
 
 def _slice_column(col: Column, lo: int, hi: int) -> Column:
+    if lo == 0 and hi == col.num_rows:
+        return col          # full range: keep identity (and host mirrors)
     v = None if col.validity is None else col.validity[lo:hi]
     if col.dtype.is_variable_width:
-        offs = col.offsets[lo:hi + 1]
-        clo = int(col.offsets[lo])
-        chi = int(col.offsets[hi])
-        return Column(col.dtype, col.data[clo:chi], offs - clo, v)
+        from ..utils import hostcache
+        host = hostcache.host_i64(col.offsets)   # one pull, reused per batch
+        clo, chi = int(host[lo]), int(host[hi])
+        rebased = host[lo:hi + 1] - clo
+        offs = jnp.asarray(rebased.astype(np.int32))
+        hostcache.seed(offs, rebased)
+        return Column(col.dtype, col.data[clo:chi], offs, v)
     return Column(col.dtype, col.data[lo:hi], validity=v)
 
 
@@ -821,65 +836,115 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
         return Table(cols)
 
     from . import ragged
+    from ..utils import hostcache
     bdata = batch.device_u8()   # var path is byte-granular (DMA engine)
     if (ragged.dma_supported()
             and len(layout.variable_column_indices) <= _DMA_MAX_VAR_COLS):
         # DMA path (copy_strings_from_rows analog, row_conversion.cu:
         # 1131-1174): the fixed region of every row is pulled into one
-        # dense matrix, decomposed with static slices; each string
-        # column's chars are then one segmented copy.  The host sync on
-        # the (offset,len) slots mirrors the reference's sync on the
-        # scanned char totals (row_conversion.cu:2215).
-        offs_np = np.asarray(batch.offsets, dtype=np.int64)
+        # dense matrix (aligned-window DMA; the batch offsets' host mirror
+        # is cache-seeded by convert_to_rows) and decomposed with static
+        # slices.  Chars are then extracted per string column:
+        #   * small n — host slot metadata is cheap: one stacked slot sync
+        #     + one segmented-copy DMA kernel per column;
+        #   * large n — the tunnel streams D2H at single-digit MB/s, so
+        #     per-row slots stay on DEVICE: output offsets are a device
+        #     cumsum, chars come from the marker-cumsum gather, and the
+        #     only sync is the per-column char totals (+ a violation
+        #     count), mirroring the reference's sync on the scanned totals
+        #     (row_conversion.cu:2215).
+        offs_np = hostcache.host_i64(batch.offsets)
         row_base_np = offs_np[:-1]
         fixed_dense = ragged.unpack(bdata, offs_np,
                                     layout.fixed_plus_validity)
         datas, valid, slots = _var_fixed_extract(layout, fixed_dense)
         row_sizes_np = offs_np[1:] - offs_np[:-1]
-        # ONE host sync for all columns' slots (each eager transfer costs a
-        # full round-trip on remote backends); mirrors the reference's
-        # single sync on the scanned totals (row_conversion.cu:2215)
-        slots_np = (np.asarray(jnp.stack(slots), dtype=np.int64)
-                    if slots else np.zeros((0, n, 2), np.int64))
+        nvar = len(layout.variable_column_indices)
         out_offsets = []
         chars = []
-        for vi in range(len(layout.variable_column_indices)):
-            s = slots_np[vi]
-            lens = s[:, 1]
-            # rows may be shuffle-received: validate the embedded slots
-            # before sizing any allocation (same hardening as the C++ host
-            # engine, host_table.cpp srjt_from_rows)
-            if ((s[:, 0] < layout.fixed_plus_validity)
-                    | (s[:, 0] + lens > row_sizes_np)).any():
+        if n <= _DMA_FROM_ROWS_MAX_N:
+            # ONE host sync for all columns' slots
+            slots_np = (np.asarray(jnp.stack(slots), dtype=np.int64)
+                        if slots else np.zeros((0, n, 2), np.int64))
+            for vi in range(nvar):
+                s = slots_np[vi]
+                lens = s[:, 1]
+                # rows may be shuffle-received: validate the embedded slots
+                # before sizing any allocation (same hardening as the C++
+                # host engine, host_table.cpp srjt_from_rows)
+                if ((s[:, 0] < layout.fixed_plus_validity)
+                        | (s[:, 0] + lens > row_sizes_np)).any():
+                    raise ValueError(
+                        "corrupt row data: string slot outside its row")
+                offs = np.zeros(n + 1, dtype=np.int64)
+                np.cumsum(lens, out=offs[1:])
+                joffs = jnp.asarray(offs)
+                hostcache.seed(joffs, offs)   # host-born: free mirror
+                out_offsets.append(joffs)
+                chars.append(ragged.copy_segments(
+                    bdata, row_base_np + s[:, 0], offs[:-1], lens,
+                    int(offs[-1])))
+        else:
+            row_base = batch.offsets[:-1].astype(jnp.int64)
+            row_sizes = (batch.offsets[1:]
+                         - batch.offsets[:-1]).astype(jnp.int64)
+            out_offsets = [
+                jnp.concatenate([jnp.zeros((1,), jnp.int64),
+                                 jnp.cumsum(s[:, 1].astype(jnp.int64))])
+                for s in slots]
+            fpv = layout.fixed_plus_validity
+            viol = [jnp.sum(((s[:, 0] < fpv)
+                             | (s[:, 0].astype(jnp.int64)
+                                + s[:, 1] > row_sizes))
+                            .astype(jnp.int32)) for s in slots]
+            # one stacked tiny sync: totals + violation counts
+            meta = np.asarray(jnp.stack(
+                [jnp.stack([o[-1], v.astype(jnp.int64)])
+                 for o, v in zip(out_offsets, viol)]))
+            if meta[:, 1].any():
                 raise ValueError(
                     "corrupt row data: string slot outside its row")
-            offs = np.zeros(n + 1, dtype=np.int64)
-            np.cumsum(lens, out=offs[1:])
-            out_offsets.append(jnp.asarray(offs))
-            chars.append(ragged.copy_segments(
-                bdata, row_base_np + s[:, 0], offs[:-1], lens,
-                int(offs[-1])))
+            for vi in range(nvar):
+                chars.append(_gather_chars(
+                    int(meta[vi, 0]), bdata, row_base, slots[vi],
+                    out_offsets[vi]))
         return _assemble(schema, datas, valid, tuple(chars),
                          [o.astype(jnp.int32) for o in out_offsets])
 
     row_offsets = batch.offsets.astype(jnp.int64)
 
-    # strings: phase 1 — lengths; host sync for char totals (reference syncs
-    # identically at row_conversion.cu:2215)
+    # XLA gather path (> _DMA_MAX_VAR_COLS string columns, or no DMA
+    # backend): slot lengths stay on DEVICE; the only host sync is the
+    # per-column char totals.
     slots = _gather_var_slots(layout, bdata, row_offsets)
-    out_offsets = []
-    char_totals = []
-    for s in slots:
-        lens = np.asarray(s[:, 1], dtype=np.int64)
-        offs = np.zeros(n + 1, dtype=np.int64)
-        np.cumsum(lens, out=offs[1:])
-        out_offsets.append(jnp.asarray(offs))
-        char_totals.append(int(offs[-1]))
+    out_offsets = [
+        jnp.concatenate([jnp.zeros((1,), jnp.int64),
+                         jnp.cumsum(s[:, 1].astype(jnp.int64))])
+        for s in slots]
+    totals_np = (np.asarray(jnp.stack([o[-1] for o in out_offsets]))
+                 if out_offsets else np.zeros((0,), np.int64))
+    char_totals = [int(t) for t in totals_np]
     datas, valid, chars = _from_rows_var(
         layout, tuple(char_totals), bdata, row_offsets,
         tuple(out_offsets), slots)
     return _assemble(schema, datas, valid, chars,
                      [o.astype(jnp.int32) for o in out_offsets])
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _gather_chars(total: int, data: jnp.ndarray, row_base: jnp.ndarray,
+                  slot: jnp.ndarray, out_offs: jnp.ndarray) -> jnp.ndarray:
+    """One string column's chars from packed rows, fully on device: char k
+    belongs to the row found by the marker-cumsum (no per-char binary
+    search) and reads ``data[row_start + slot_off + (k - out_offs[row])]``.
+    """
+    if total == 0:
+        return jnp.zeros((0,), jnp.uint8)
+    row_of = _segment_of(out_offs.astype(jnp.int32), total)
+    k = jnp.arange(total, dtype=jnp.int64)
+    src = (row_base[row_of] + slot[row_of, 0].astype(jnp.int64)
+           + (k - out_offs[row_of]))
+    return data[jnp.clip(src, 0, data.shape[0] - 1)]
 
 
 def _assemble(schema, datas, valid, chars, out_offsets) -> Table:

@@ -271,6 +271,23 @@ def _pack_rows_impl(dense, row_offsets, block_bytes):
         np.pad(offs32, (0, offs_rows * LANE - offs32.shape[0]))
         .reshape(offs_rows, LANE))
 
+    out = _pack_call(nblocks, SB, MwS, NR, KOFF, B)(
+        jnp.asarray(r0), jnp.asarray(rb), jnp.asarray(nr), offs2d, dense32)
+    return u32_to_u8(out.reshape(-1))[:total]
+
+
+@functools.lru_cache(maxsize=512)
+def _pack_call(nblocks, SB, MwS, NR, KOFF, B):
+    """Cached jitted pallas_call for one pack geometry.
+
+    The kernel closure and pallas_call wrapper MUST be built once per
+    static tuple and reused: jax's dispatch cache keys on the callable's
+    identity, so a fresh closure per call forces a full Mosaic recompile
+    every call (~1 s each — this dominated the round-2 string transcode).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
     def kernel(r0_ref, rb_ref, nr_ref, offs_hbm, dense_hbm, out_ref,
                scratch, soffs, sems):
         b = pl.program_id(0)
@@ -320,12 +337,10 @@ def _pack_rows_impl(dense, row_offsets, block_bytes):
         scratch_shapes=[pltpu.VMEM((NR, MwS, LANE), jnp.uint32),
                         pltpu.SMEM((KOFF, LANE), jnp.int32),
                         pltpu.SemaphoreType.DMA((1 + KOFF,))])
-    out = pl.pallas_call(
+    return jax.jit(pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((nblocks, SB, LANE), jnp.uint32),
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
-    )(jnp.asarray(r0), jnp.asarray(rb), jnp.asarray(nr), offs2d, dense32)
-    return u32_to_u8(out.reshape(-1))[:total]
+        compiler_params=pltpu.CompilerParams(has_side_effects=True)))
 
 
 # ---------------------------------------------------------------------------
@@ -381,6 +396,18 @@ def _unpack_rows_impl(flat, row_offsets, M, rows_per_block):
         np.pad(offs32, (0, offs_rows * LANE - offs32.shape[0]))
         .reshape(offs_rows, LANE))
 
+    out = _unpack_call(nblocks, RB, MwS, KS, KOFF)(
+        jnp.asarray(start_word_row), offs2d, flat32)
+    dense = u32_to_u8(out.reshape(-1)).reshape(n_pad, Mp)
+    return dense[:n, :M]
+
+
+@functools.lru_cache(maxsize=512)
+def _unpack_call(nblocks, RB, MwS, KS, KOFF):
+    """Cached jitted pallas_call for one unpack geometry (see _pack_call)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
     def kernel(sw_ref, offs_hbm, flat_hbm, out_ref, win, soffs, sems):
         b = pl.program_id(0)
         dma = pltpu.make_async_copy(flat_hbm.at[pl.ds(sw_ref[b], KS)], win,
@@ -416,13 +443,10 @@ def _unpack_rows_impl(flat, row_offsets, M, rows_per_block):
         scratch_shapes=[pltpu.VMEM((KS, LANE), jnp.uint32),
                         pltpu.SMEM((KOFF, LANE), jnp.int32),
                         pltpu.SemaphoreType.DMA((1 + KOFF,))])
-    out = pl.pallas_call(
+    return jax.jit(pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((nblocks, RB, MwS, LANE), jnp.uint32),
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
-    )(jnp.asarray(start_word_row), offs2d, flat32)
-    dense = u32_to_u8(out.reshape(-1)).reshape(n_pad, Mp)
-    return dense[:n, :M]
+        compiler_params=pltpu.CompilerParams(has_side_effects=True)))
 
 
 # ---------------------------------------------------------------------------
@@ -514,6 +538,19 @@ def _segmented_copy_impl(src, src_offs, dst_offs, sizes, dst_size, B):
     sw = (w0 // 4 // LANE).astype(np.int32)      # window start (sublane rows)
     sb32 = s_begin.astype(np.int32)
 
+    out = _segcopy_call(nblocks, SB, B, KSw, KMETA)(
+        jnp.asarray(sw), jnp.asarray(sb32), jnp.asarray(ns),
+        srcm, dstm, szm, src32)
+    return u32_to_u8(out.reshape(-1))[:dst_size]
+
+
+@functools.lru_cache(maxsize=512)
+def _segcopy_call(nblocks, SB, B, KSw, KMETA):
+    """Cached jitted pallas_call for one segmented-copy geometry (see
+    _pack_call)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
     def kernel(sw_ref, sb_ref, ns_ref, srcm_hbm, dstm_hbm, szm_hbm, src_hbm,
                out_ref, win, ssrc, sdst, ssz, sems):
         b = pl.program_id(0)
@@ -575,13 +612,10 @@ def _segmented_copy_impl(src, src_offs, dst_offs, sizes, dst_size, B):
                         pltpu.SMEM((KMETA, LANE), jnp.int32),
                         pltpu.SMEM((KMETA, LANE), jnp.int32),
                         pltpu.SemaphoreType.DMA((1 + 3 * KMETA,))])
-    out = pl.pallas_call(
+    return jax.jit(pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((nblocks, SB, LANE), jnp.uint32),
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
-    )(jnp.asarray(sw), jnp.asarray(sb32), jnp.asarray(ns),
-      srcm, dstm, szm, src32)
-    return u32_to_u8(out.reshape(-1))[:dst_size]
+        compiler_params=pltpu.CompilerParams(has_side_effects=True)))
 
 
 def segmented_copy_xla(src, src_offs, dst_offs, sizes, dst_size):

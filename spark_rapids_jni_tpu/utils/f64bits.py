@@ -19,12 +19,19 @@ at their boundaries via :func:`from_bits` / :func:`to_bits`:
 
 * on backends with native f64 bitcast (CPU — where the test suite runs) the
   conversion is a bitcast: exact, including NaN payloads and denormals;
-* on TPU it is exact *arithmetic* bit assembly/extraction built from
-  operations the emulation performs exactly (power-of-two scaling, integer
-  <-> f64 converts below 2^52, compares):  values round-trip bit-exactly for
-  normals, +-0 and +-inf; NaNs canonicalize to 0x7FF8_0000_0000_0000; and
-  denormals flush to zero — which the TPU's f64 emulation does to any
-  arithmetic result anyway, so no *computed* value can hit the lossy case.
+* on TPU it is *arithmetic* bit assembly/extraction built from operations
+  the emulation performs exactly where it can (power-of-two scaling,
+  compares).  The emulation itself carries only ~47-49 effective mantissa
+  bits AND an f32-like exponent window (measured on the target chip,
+  round 3: 2^126 survives, 2^127 -> inf; gradual underflow below ~2^-126),
+  so decoded values land within a few ulps of the IEEE value inside that
+  window — the closest the hardware can represent — are exact for powers
+  of two, +-0 and +-inf, and degrade to +-inf / 0 outside it; NaNs
+  canonicalize to 0x7FF8_0000_0000_0000.  This is the same precision every
+  f64 *computation* on this backend already has (a plain ``jnp.sum`` of
+  1e300 is inf on this chip); anything needing bit-exactness (transcode,
+  shuffle, Parquet) moves the stored bits untouched and never calls these
+  functions.
 
 Reference parity: the reference gets f64 bit access for free in CUDA
 (``row_conversion.cu`` copies raw bytes); this module is the TPU-native
@@ -123,15 +130,18 @@ def to_bits(x: jnp.ndarray) -> jnp.ndarray:
     is_inf = a == jnp.asarray(np.inf, jnp.float64)
     finite_pos = (~is_nan) & (~is_inf) & (a > 0)
     a_safe = jnp.where(finite_pos, a, 1.0)
-    # Normalize a_safe into [1, 2), accumulating floor(log2 a) in e.  Two
-    # conditional x2^537 steps lift any positive value (>= 2^-1074) to >= 1
-    # while keeping it < 2^1024, inside the descent loop's 1023 range.
+    # Normalize a_safe into [1, 2), accumulating floor(log2 a) in e.  Every
+    # scale factor must stay INSIDE the emulation's f32-like exponent
+    # window (2^127 -> inf on this backend), so the lift uses three
+    # conditional x2^75 steps (covers |x| >= 2^-225, far below the
+    # emulation's ~2^-149 floor) and the descent tops out at 2^64
+    # (64+32+...+1 = 127 covers the window's 2^127 ceiling).
     e = jnp.zeros(x.shape, jnp.int32)
-    for _ in range(2):
+    for _ in range(3):
         tiny = a_safe < 1.0
-        a_safe = jnp.where(tiny, a_safe * np.float64(2.0 ** 537), a_safe)
-        e = e - jnp.where(tiny, jnp.int32(537), jnp.int32(0))
-    for k in _EXP_STEPS:
+        a_safe = jnp.where(tiny, a_safe * np.float64(2.0 ** 75), a_safe)
+        e = e - jnp.where(tiny, jnp.int32(75), jnp.int32(0))
+    for k in (64, 32, 16, 8, 4, 2, 1):
         c = a_safe >= np.float64(2.0 ** k)
         a_safe = jnp.where(c, a_safe * np.float64(2.0 ** -k), a_safe)
         e = e + jnp.where(c, jnp.int32(k), jnp.int32(0))
@@ -142,9 +152,14 @@ def to_bits(x: jnp.ndarray) -> jnp.ndarray:
     e = e + roll.astype(jnp.int32)
     biased = e + 1023
     # Underflow flushes to signed zero (the emulation cannot hold denormals);
-    # overflow saturates to infinity.
-    to_inf = is_inf | (finite_pos & (biased >= 0x7FF))
-    to_zero = (~is_nan) & (~to_inf) & ((a == 0) | (biased <= 0))
+    # overflow — or a magnitude beyond the descent's 2^127 reach, possible
+    # only on native-f64 backends exercising this path — saturates to inf.
+    to_inf = is_inf | (finite_pos & ((biased >= 0x7FF) | (a_safe >= 2.0)))
+    # a_safe < 1 after the lifts means |x| < 2^-225 — below the lift range
+    # (possible only on native-f64 backends exercising this path): flush to
+    # signed zero, symmetric with the a_safe >= 2 overflow guard above.
+    to_zero = ((~is_nan) & (~to_inf)
+               & ((a == 0) | (biased <= 0) | (a_safe < 1.0)))
     biased = jnp.where(to_zero, 0, jnp.where(to_inf, 0x7FF, biased))
     mant = jnp.where(to_zero | to_inf, 0, mant)
     biased = jnp.where(is_nan, 0x7FF, biased)
