@@ -91,5 +91,20 @@ def generate(n_sales: int = 100_000, n_items: int = 2000,
             (price_cents * qty).astype(np.float64) / 100.0),
     })
 
+    # second fact table (the multi-fact union family: Q71/Q76 shape)
+    n_web = max(n_sales // 3, 1)
+    w_price = rng.integers(100, 300_00, n_web).astype(np.int64)
+    w_qty = rng.integers(1, 100, n_web).astype(np.int32)
+    web_sales = pa.table({
+        "ws_sold_date_sk": pa.array(
+            rng.integers(1, n_dates + 1, n_web).astype(np.int32)),
+        "ws_item_sk": pa.array(
+            rng.integers(1, n_items + 1, n_web).astype(np.int32)),
+        "ws_quantity": pa.array(w_qty),
+        "ws_ext_sales_price": pa.array(
+            (w_price * w_qty).astype(np.float64) / 100.0),
+    })
+
     return {"store_sales": _parquet(store_sales), "item": _parquet(item),
-            "date_dim": _parquet(date_dim), "store": _parquet(store)}
+            "date_dim": _parquet(date_dim), "store": _parquet(store),
+            "web_sales": _parquet(web_sales)}

@@ -16,18 +16,23 @@ dynamic shapes outside the two-phase sync points.
 
 from __future__ import annotations
 
+import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
 from ..column import Column, Table
-from ..ops import (apply_boolean_mask, groupby_aggregate, inner_join,
+from ..ops import (apply_boolean_mask, concat_tables, distinct,
+                   groupby_aggregate, groupby_nunique, inner_join, isin,
                    left_join, mean, slice_table, sort_table)
 from ..ops import strings as S
+from ..ops import window as W
 from ..parquet import decode
 
 SS_COLS = ["ss_sold_date_sk", "ss_item_sk", "ss_store_sk", "ss_quantity",
            "ss_sales_price_cents", "ss_list_price_cents",
            "ss_ext_sales_price"]
+WS_COLS = ["ws_sold_date_sk", "ws_item_sk", "ws_quantity",
+           "ws_ext_sales_price"]
 ITEM_COLS = ["i_item_sk", "i_item_id", "i_current_price", "i_brand_id",
              "i_brand", "i_category_id", "i_category", "i_manufact_id",
              "i_manager_id"]
@@ -42,6 +47,9 @@ def load_tables(files: dict[str, bytes]) -> dict[str, Table]:
         "item": decode.read_table(files["item"], columns=ITEM_COLS),
         "date_dim": decode.read_table(files["date_dim"], columns=DATE_COLS),
         "store": decode.read_table(files["store"], columns=STORE_COLS),
+        **({"web_sales": decode.read_table(files["web_sales"],
+                                           columns=WS_COLS)}
+           if "web_sales" in files else {}),
     }
 
 
@@ -276,12 +284,174 @@ def q_store_counts(tables: dict[str, Table]) -> Table:
     return sort_table(out, [0])
 
 
+def q67_rank(tables: dict[str, Table], top_n: int = 3) -> Table:
+    """Top-N brands per category by revenue — the Q67 window shape:
+    aggregate, then RANK() OVER (PARTITION BY category ORDER BY sum DESC)
+    and keep rank <= N."""
+    ss, item = tables["store_sales"], tables["item"]
+    j = inner_join(ss, item, _col(SS_COLS, "ss_item_sk"),
+                   _col(ITEM_COLS, "i_item_sk"))
+    cols = SS_COLS + ITEM_COLS
+    rev = groupby_aggregate(
+        j, [cols.index("i_category"), cols.index("i_brand_id")],
+        [(cols.index("ss_ext_sales_price"), "sum")])
+    # rev: [i_category, i_brand_id, sum]
+    spec = W.WindowSpec(rev, partition_by=[0], order_by_keys=[2, 1],
+                        ascending=[False, True])
+    rk = W.rank(spec, [2, 1])
+    keep = rk.values() <= top_n
+    out = apply_boolean_mask(Table(list(rev.columns) + [rk]), keep)
+    return sort_table(out, [0, 3, 1])
+
+
+def q_like_brands(tables: dict[str, Table], pat: str = "#1",
+                  cat_prefix: str = "S") -> Table:
+    """LIKE/substring-heavy predicate family (Q45/Q23 spirit): revenue of
+    items whose brand CONTAINS ``pat`` and whose category STARTS WITH
+    ``cat_prefix`` (via substring equality), grouped by category."""
+    ss, item = tables["store_sales"], tables["item"]
+    brand_has = S.contains(item[_col(ITEM_COLS, "i_brand")], pat)
+    cat_ok = S.starts_with(item[_col(ITEM_COLS, "i_category")], cat_prefix)
+    m = (brand_has.data.astype(bool) & cat_ok.data.astype(bool))
+    item_f = apply_boolean_mask(item, m)
+    j = inner_join(ss, item_f, _col(SS_COLS, "ss_item_sk"),
+                   _col(ITEM_COLS, "i_item_sk"))
+    return _group_sum(j, SS_COLS + ITEM_COLS, ["i_category"],
+                      "ss_ext_sales_price")
+
+
+def q_union_channels(tables: dict[str, Table]) -> Table:
+    """Multi-fact UNION ALL (Q71/Q76 shape): store + web revenue per
+    category — both facts projected to a common (item_sk, price) schema,
+    concatenated, then joined and grouped."""
+    ss, ws, item = (tables["store_sales"], tables["web_sales"],
+                    tables["item"])
+    common = ["item_sk", "price"]
+    part_s = Table([ss[_col(SS_COLS, "ss_item_sk")],
+                    ss[_col(SS_COLS, "ss_ext_sales_price")]])
+    part_w = Table([ws[_col(WS_COLS, "ws_item_sk")],
+                    ws[_col(WS_COLS, "ws_ext_sales_price")]])
+    both = concat_tables([part_s, part_w])
+    j = inner_join(both, item, 0, _col(ITEM_COLS, "i_item_sk"))
+    return _group_sum(j, common + ITEM_COLS, ["i_category"], "price")
+
+
+def q_lag_growth(tables: dict[str, Table]) -> Table:
+    """Month-over-month revenue delta per store (window LAG shape):
+    aggregate per (store, year, month), then value - LAG(value) within the
+    store partition ordered by (year, month)."""
+    ss, dd = tables["store_sales"], tables["date_dim"]
+    j = inner_join(ss, dd, _col(SS_COLS, "ss_sold_date_sk"),
+                   _col(DATE_COLS, "d_date_sk"))
+    cols = SS_COLS + DATE_COLS
+    rev = groupby_aggregate(
+        j, [cols.index("ss_store_sk"), cols.index("d_year"),
+            cols.index("d_moy")],
+        [(cols.index("ss_ext_sales_price"), "sum")])
+    # rev: [store, year, moy, sum]
+    spec = W.WindowSpec(rev, partition_by=[0], order_by_keys=[1, 2])
+    prev = W.lag(spec, 3, 1)
+    pv = jnp.where(prev.validity_or_true(), prev.values(), 0.0)
+    delta = Column.from_values(T.float64, rev[3].values() - pv,
+                               validity=prev.validity)
+    out = Table(list(rev.columns) + [delta])
+    return sort_table(out, [0, 1, 2])
+
+
+def q_running_share(tables: dict[str, Table], year: int = 2000) -> Table:
+    """Cumulative revenue per store across months (window running-sum
+    shape, Q47 spirit)."""
+    ss, dd = tables["store_sales"], tables["date_dim"]
+    dd_f = apply_boolean_mask(
+        dd, _eq_scalar_mask(dd[_col(DATE_COLS, "d_year")], year))
+    j = inner_join(ss, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
+                   _col(DATE_COLS, "d_date_sk"))
+    cols = SS_COLS + DATE_COLS
+    rev = groupby_aggregate(
+        j, [cols.index("ss_store_sk"), cols.index("d_moy")],
+        [(cols.index("ss_ext_sales_price"), "sum")])
+    spec = W.WindowSpec(rev, partition_by=[0], order_by_keys=[1])
+    cum = W.running_sum(spec, 2)
+    return sort_table(Table(list(rev.columns) + [cum]), [0, 1])
+
+
+def q_nunique_items(tables: dict[str, Table]) -> Table:
+    """COUNT(DISTINCT item) per store (Q14-family distinct-count shape)."""
+    ss = tables["store_sales"]
+    out = groupby_nunique(ss, [_col(SS_COLS, "ss_store_sk")],
+                          _col(SS_COLS, "ss_item_sk"))
+    return sort_table(out, [0])
+
+
+def q_having(tables: dict[str, Table], min_total: float = 1000.0) -> Table:
+    """GROUP BY brand HAVING SUM(price) > threshold (Q23 HAVING shape):
+    aggregate, then filter on the aggregate."""
+    ss, item = tables["store_sales"], tables["item"]
+    j = inner_join(ss, item, _col(SS_COLS, "ss_item_sk"),
+                   _col(ITEM_COLS, "i_item_sk"))
+    cols = SS_COLS + ITEM_COLS
+    rev = groupby_aggregate(j, [cols.index("i_brand_id")],
+                            [(cols.index("ss_ext_sales_price"), "sum")])
+    keep = rev[1].values() > min_total
+    return sort_table(apply_boolean_mask(rev, keep), [0])
+
+
+def q_case_when(tables: dict[str, Table], qty_cut: int = 50) -> Table:
+    """Conditional aggregation (Q34/CASE WHEN shape): per category, revenue
+    from bulk rows (qty > cut) vs retail rows, in one pass via two masked
+    value columns."""
+    ss, item = tables["store_sales"], tables["item"]
+    j = inner_join(ss, item, _col(SS_COLS, "ss_item_sk"),
+                   _col(ITEM_COLS, "i_item_sk"))
+    cols = SS_COLS + ITEM_COLS
+    qcol = j[cols.index("ss_quantity")]
+    pcol = j[cols.index("ss_ext_sales_price")]
+    # SQL semantics: NULL qty fails the WHEN (ELSE branch); SUM skips NULL
+    # prices (they contribute 0 to either branch)
+    price = jnp.where(pcol.validity_or_true(), pcol.values(), 0.0)
+    bulk = qcol.validity_or_true() & (qcol.data > qty_cut)
+    cb = Column.from_values(T.float64, jnp.where(bulk, price, 0.0))
+    cr = Column.from_values(T.float64, jnp.where(bulk, 0.0, price))
+    work = Table(list(j.columns) + [cb, cr])
+    out = groupby_aggregate(
+        work, [cols.index("i_category")],
+        [(len(cols), "sum"), (len(cols) + 1, "sum")])
+    return sort_table(out, [0])
+
+
+def q_distinct_pairs(tables: dict[str, Table]) -> Table:
+    """DISTINCT (brand_id, category_id) pairs (dropDuplicates shape)."""
+    item = tables["item"]
+    pairs = Table([item[_col(ITEM_COLS, "i_brand_id")],
+                   item[_col(ITEM_COLS, "i_category_id")]])
+    return sort_table(distinct(pairs), [0, 1])
+
+
+def q_isin_states(tables: dict[str, Table],
+                  states: tuple = ("TN", "CA")) -> Table:
+    """Revenue for stores in an IN-list of states (SQL IN shape)."""
+    ss, store = tables["store_sales"], tables["store"]
+    m = isin(store[_col(STORE_COLS, "s_state")], list(states))
+    store_f = apply_boolean_mask(store, m)
+    j = inner_join(ss, store_f, _col(SS_COLS, "ss_store_sk"),
+                   _col(STORE_COLS, "s_store_sk"))
+    return _group_sum(j, SS_COLS + STORE_COLS, ["s_state"],
+                      "ss_ext_sales_price")
+
+
 QUERIES = {"q3": q3, "q42": q42, "q52": q52, "q55": q55,
            "q_state_rollup": q_state_rollup, "q7": q7, "q19": q19,
            "q62": q62, "q52_topn": q52_topn, "q65": q65,
-           "q_store_counts": q_store_counts}
+           "q_store_counts": q_store_counts,
+           "q67_rank": q67_rank, "q_like_brands": q_like_brands,
+           "q_union_channels": q_union_channels, "q_lag_growth": q_lag_growth,
+           "q_running_share": q_running_share,
+           "q_nunique_items": q_nunique_items, "q_having": q_having,
+           "q_case_when": q_case_when, "q_distinct_pairs": q_distinct_pairs,
+           "q_isin_states": q_isin_states}
 
 
 def run_all(files: dict[str, bytes]) -> dict[str, Table]:
     tables = load_tables(files)
-    return {name: fn(tables) for name, fn in QUERIES.items()}
+    return {name: fn(tables) for name, fn in QUERIES.items()
+            if name != "q_union_channels" or "web_sales" in tables}

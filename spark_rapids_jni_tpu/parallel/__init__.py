@@ -1,2 +1,3 @@
 from .mesh import make_mesh  # noqa: F401
 from .shuffle import bucketize_rows, all_to_all_shuffle  # noqa: F401
+from .repartition_join import (JoinAggSpec, repartition_join_agg)  # noqa: F401

@@ -1,0 +1,144 @@
+"""Repartition (shuffled) hash equi-join over the 8-device CPU mesh.
+
+The q65 shape: store_sales (sharded fact) ⋈ item (sharded build side — NOT
+replicated) on item_sk, aggregating sales by item category.  Differential
+oracle: pandas merge+groupby on the same host data.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+import jax
+import jax.numpy as jnp
+
+import spark_rapids_jni_tpu as sr
+from spark_rapids_jni_tpu.parallel import make_mesh
+from spark_rapids_jni_tpu.parallel.repartition_join import (
+    JoinAggSpec, repartition_join_agg)
+
+N_DEV = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(N_DEV, "data")
+
+
+def _case(n_fact=4096, n_item=512, n_cat=7, null_keys=False, seed=0):
+    rng = np.random.default_rng(seed)
+    item_sk = rng.permutation(np.arange(10_000, dtype=np.int64))[:n_item]
+    item_cat = rng.integers(0, n_cat, n_item).astype(np.int32)
+    # fact keys: mostly joinable, some missing from item (no match)
+    fact_sk = np.where(rng.random(n_fact) < 0.85,
+                       item_sk[rng.integers(0, n_item, n_fact)],
+                       rng.integers(20_000, 30_000, n_fact)).astype(np.int64)
+    fact_qty = rng.integers(1, 100, n_fact).astype(np.int64)
+    fact_valid = np.ones((n_fact, 2), dtype=bool)
+    item_valid = np.ones((n_item, 2), dtype=bool)
+    if null_keys:
+        fact_valid[:, 0] = rng.random(n_fact) < 0.9
+        item_valid[:, 0] = rng.random(n_item) < 0.95
+    return item_sk, item_cat, fact_sk, fact_qty, fact_valid, item_valid
+
+
+def _oracle(item_sk, item_cat, fact_sk, fact_qty, fact_valid, item_valid,
+            n_cat):
+    df_i = pd.DataFrame({"sk": item_sk, "cat": item_cat})[item_valid[:, 0]]
+    df_f = pd.DataFrame({"sk": fact_sk, "qty": fact_qty})[fact_valid[:, 0]]
+    j = df_f.merge(df_i, on="sk", how="inner")
+    g = j.groupby("cat")["qty"].agg(["sum", "count"])
+    sums = np.zeros(n_cat, np.int64)
+    cnts = np.zeros(n_cat, np.int64)
+    sums[g.index.to_numpy()] = g["sum"].to_numpy()
+    cnts[g.index.to_numpy()] = g["count"].to_numpy()
+    return sums, cnts
+
+
+def _run(mesh, item_sk, item_cat, fact_sk, fact_qty, fact_valid, item_valid,
+         n_cat, fact_capacity=None, build_capacity=None):
+    n_fact, n_item = fact_sk.shape[0], item_sk.shape[0]
+    spec = JoinAggSpec(
+        fact_schema=(sr.int64, sr.int64),
+        build_schema=(sr.int64, sr.int32),
+        fact_key_idx=0, build_key_idx=0, build_group_idx=1,
+        fact_value_idx=1, num_groups=n_cat,
+        fact_capacity=fact_capacity or (2 * n_fact // N_DEV // N_DEV + 64),
+        build_capacity=build_capacity or (2 * n_item // N_DEV // N_DEV + 64))
+    sums, cnts, dropped = repartition_join_agg(
+        mesh, spec,
+        (jnp.asarray(fact_sk), jnp.asarray(fact_qty)),
+        jnp.asarray(fact_valid),
+        (jnp.asarray(item_sk), jnp.asarray(item_cat)),
+        jnp.asarray(item_valid))
+    return (np.asarray(sums), np.asarray(cnts), int(np.asarray(dropped)))
+
+
+def test_q65_shape_matches_pandas(mesh):
+    case = _case()
+    sums, cnts, dropped = _run(mesh, *case, n_cat=7)
+    want_s, want_c = _oracle(*case, n_cat=7)
+    assert dropped == 0
+    np.testing.assert_array_equal(sums, want_s)
+    np.testing.assert_array_equal(cnts, want_c)
+
+
+def test_null_keys_never_match(mesh):
+    case = _case(null_keys=True, seed=3)
+    sums, cnts, dropped = _run(mesh, *case, n_cat=7)
+    want_s, want_c = _oracle(*case, n_cat=7)
+    assert dropped == 0
+    np.testing.assert_array_equal(sums, want_s)
+    np.testing.assert_array_equal(cnts, want_c)
+
+
+def test_capacity_overflow_is_reported(mesh):
+    case = _case(seed=5)
+    _, _, dropped = _run(mesh, *case, n_cat=7, fact_capacity=2)
+    assert dropped > 0  # two-phase sizing: caller must retry with headroom
+
+
+def test_skewed_keys_all_land(mesh):
+    # heavy skew: 60% of fact rows share ONE key — they all hash to one
+    # partition, so capacity must cover the skew (reported if not)
+    rng = np.random.default_rng(9)
+    n_fact, n_item, n_cat = 2048, 64, 5
+    item_sk = np.arange(100, 100 + n_item, dtype=np.int64)
+    item_cat = rng.integers(0, n_cat, n_item).astype(np.int32)
+    hot = item_sk[7]
+    fact_sk = np.where(rng.random(n_fact) < 0.6, hot,
+                       item_sk[rng.integers(0, n_item, n_fact)]).astype(np.int64)
+    fact_qty = rng.integers(1, 10, n_fact).astype(np.int64)
+    fv = np.ones((n_fact, 2), bool)
+    iv = np.ones((n_item, 2), bool)
+    sums, cnts, dropped = _run(mesh, item_sk, item_cat, fact_sk, fact_qty,
+                               fv, iv, n_cat,
+                               fact_capacity=2 * n_fact // N_DEV)
+    want_s, want_c = _oracle(item_sk, item_cat, fact_sk, fact_qty, fv, iv,
+                             n_cat)
+    assert dropped == 0
+    np.testing.assert_array_equal(sums, want_s)
+    np.testing.assert_array_equal(cnts, want_c)
+
+
+def test_max_value_key_still_joins(mesh):
+    # a legitimate PK equal to iinfo(int64).max must not be conflated with
+    # the dead-slot sentinel
+    n_fact, n_cat = 256, 3
+    item_sk = np.asarray([5, 9, np.iinfo(np.int64).max], np.int64)
+    item_cat = np.asarray([0, 1, 2], np.int32)
+    fact_sk = np.asarray([5, np.iinfo(np.int64).max] * (n_fact // 2),
+                         np.int64)
+    fact_qty = np.ones(n_fact, np.int64)
+    fv = np.ones((n_fact, 2), bool)
+    iv = np.ones((3, 2), bool)
+    # pad item side to a multiple of the mesh (8): extra rows are nulls
+    pad = 8 - 3
+    item_sk = np.concatenate([item_sk, np.zeros(pad, np.int64)])
+    item_cat = np.concatenate([item_cat, np.zeros(pad, np.int32)])
+    iv = np.concatenate([iv, np.zeros((pad, 2), bool)])
+    sums, cnts, dropped = _run(mesh, item_sk, item_cat, fact_sk, fact_qty,
+                               fv, iv, n_cat, fact_capacity=n_fact,
+                               build_capacity=8)
+    assert dropped == 0
+    assert cnts.tolist() == [n_fact // 2, 0, n_fact // 2]
+    assert sums.tolist() == [n_fact // 2, 0, n_fact // 2]

@@ -204,3 +204,147 @@ def test_run_all_smoke(files):
     for name, t in results.items():
         assert t.num_columns >= 2, name
         assert t.num_rows >= 0, name
+
+
+# ---- round-3 additions: window / LIKE / union / distinct-count family ----
+
+def test_q67_rank(tables, dfs):
+    out = tpcds.q67_rank(tables, top_n=3)
+    ss, item = dfs["store_sales"], dfs["item"]
+    j = ss.merge(item, left_on="ss_item_sk", right_on="i_item_sk")
+    rev = (j.groupby(["i_category", "i_brand_id"], as_index=False)
+           ["ss_ext_sales_price"].sum())
+    rev["rk"] = (rev.sort_values(["ss_ext_sales_price", "i_brand_id"],
+                                 ascending=[False, True])
+                 .groupby("i_category").cumcount() + 1)
+    # pandas rank with our tie semantics: RANK over (sum desc, brand asc)
+    # has no ties because brand_id is unique within the sort
+    exp = (rev[rev.rk <= 3]
+           .sort_values(["i_category", "rk", "i_brand_id"])
+           .reset_index(drop=True))
+    assert out.num_rows == len(exp)
+    assert out[0].to_pylist() == exp["i_category"].tolist()
+    assert out[1].to_numpy().tolist() == exp["i_brand_id"].tolist()
+    np.testing.assert_allclose(out[2].to_numpy(),
+                               exp["ss_ext_sales_price"].to_numpy(),
+                               rtol=1e-9)
+    assert out[3].to_numpy().tolist() == exp["rk"].tolist()
+
+
+def test_q_like_brands(tables, dfs):
+    out = tpcds.q_like_brands(tables, pat="#1", cat_prefix="S")
+    ss, item = dfs["store_sales"], dfs["item"]
+    item_f = item[item.i_brand.str.contains("#1", regex=False)
+                  & item.i_category.str.startswith("S")]
+    j = ss.merge(item_f, left_on="ss_item_sk", right_on="i_item_sk")
+    exp = (j.groupby(["i_category"], as_index=False)
+           ["ss_ext_sales_price"].sum())
+    _assert_result(out, exp, ["i_category"],
+                   [("ss_ext_sales_price", "float")])
+
+
+def test_q_union_channels(tables, dfs):
+    out = tpcds.q_union_channels(tables)
+    ss, ws, item = dfs["store_sales"], dfs["web_sales"], dfs["item"]
+    both = pd.concat([
+        ss[["ss_item_sk", "ss_ext_sales_price"]]
+        .rename(columns={"ss_item_sk": "sk", "ss_ext_sales_price": "price"}),
+        ws[["ws_item_sk", "ws_ext_sales_price"]]
+        .rename(columns={"ws_item_sk": "sk", "ws_ext_sales_price": "price"}),
+    ])
+    j = both.merge(item, left_on="sk", right_on="i_item_sk")
+    exp = j.groupby(["i_category"], as_index=False)["price"].sum()
+    _assert_result(out, exp, ["i_category"], [("price", "float")])
+
+
+def test_q_lag_growth(tables, dfs):
+    out = tpcds.q_lag_growth(tables)
+    ss, dd = dfs["store_sales"], dfs["date_dim"]
+    j = ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+    rev = (j.groupby(["ss_store_sk", "d_year", "d_moy"], as_index=False)
+           ["ss_ext_sales_price"].sum()
+           .sort_values(["ss_store_sk", "d_year", "d_moy"])
+           .reset_index(drop=True))
+    prev = rev.groupby("ss_store_sk")["ss_ext_sales_price"].shift(1)
+    delta = rev["ss_ext_sales_price"] - prev.fillna(0.0)
+    assert out.num_rows == len(rev)
+    np.testing.assert_array_equal(out[0].to_numpy(),
+                                  rev["ss_store_sk"].to_numpy())
+    got_delta = np.asarray(
+        [v if v is not None else np.nan for v in out[4].to_pylist()])
+    want = np.where(prev.isna().to_numpy(), np.nan, delta.to_numpy())
+    np.testing.assert_allclose(got_delta, want, rtol=1e-9)
+
+
+def test_q_running_share(tables, dfs):
+    out = tpcds.q_running_share(tables, year=2000)
+    ss, dd = dfs["store_sales"], dfs["date_dim"]
+    j = ss.merge(dd[dd.d_year == 2000], left_on="ss_sold_date_sk",
+                 right_on="d_date_sk")
+    rev = (j.groupby(["ss_store_sk", "d_moy"], as_index=False)
+           ["ss_ext_sales_price"].sum()
+           .sort_values(["ss_store_sk", "d_moy"]).reset_index(drop=True))
+    rev["cum"] = rev.groupby("ss_store_sk")["ss_ext_sales_price"].cumsum()
+    assert out.num_rows == len(rev)
+    np.testing.assert_allclose(out[3].to_numpy(), rev["cum"].to_numpy(),
+                               rtol=1e-9)
+
+
+def test_q_nunique_items(tables, dfs):
+    out = tpcds.q_nunique_items(tables)
+    ss = dfs["store_sales"]
+    exp = (ss.groupby("ss_store_sk")["ss_item_sk"].nunique()
+           .reset_index().sort_values("ss_store_sk"))
+    assert out[0].to_numpy().tolist() == exp["ss_store_sk"].tolist()
+    assert out[1].to_numpy().tolist() == exp["ss_item_sk"].tolist()
+
+
+def test_q_having(tables, dfs):
+    out = tpcds.q_having(tables, min_total=1000.0)
+    ss, item = dfs["store_sales"], dfs["item"]
+    j = ss.merge(item, left_on="ss_item_sk", right_on="i_item_sk")
+    rev = (j.groupby("i_brand_id", as_index=False)
+           ["ss_ext_sales_price"].sum())
+    exp = rev[rev.ss_ext_sales_price > 1000.0].sort_values("i_brand_id")
+    assert out[0].to_numpy().tolist() == exp["i_brand_id"].tolist()
+    np.testing.assert_allclose(out[1].to_numpy(),
+                               exp["ss_ext_sales_price"].to_numpy(),
+                               rtol=1e-9)
+
+
+def test_q_case_when(tables, dfs):
+    out = tpcds.q_case_when(tables, qty_cut=50)
+    ss, item = dfs["store_sales"], dfs["item"]
+    j = ss.merge(item, left_on="ss_item_sk", right_on="i_item_sk")
+    j = j.assign(bulk=np.where(j.ss_quantity > 50, j.ss_ext_sales_price, 0.0),
+                 retail=np.where(j.ss_quantity > 50, 0.0,
+                                 j.ss_ext_sales_price))
+    exp = j.groupby("i_category", as_index=False)[["bulk", "retail"]].sum()
+    _assert_result(out, exp, ["i_category"],
+                   [("bulk", "float"), ("retail", "float")])
+
+
+def test_q_distinct_pairs(tables, dfs):
+    out = tpcds.q_distinct_pairs(tables)
+    item = dfs["item"]
+    exp = (item[["i_brand_id", "i_category_id"]].drop_duplicates()
+           .sort_values(["i_brand_id", "i_category_id"]))
+    assert out.num_rows == len(exp)
+    assert out[0].to_numpy().tolist() == exp["i_brand_id"].tolist()
+    assert out[1].to_numpy().tolist() == exp["i_category_id"].tolist()
+
+
+def test_q_isin_states(tables, dfs):
+    out = tpcds.q_isin_states(tables, states=("TN", "CA"))
+    ss, store = dfs["store_sales"], dfs["store"]
+    store_f = store[store.s_state.isin(["TN", "CA"])]
+    j = ss.merge(store_f, left_on="ss_store_sk", right_on="s_store_sk")
+    exp = j.groupby(["s_state"], as_index=False)["ss_ext_sales_price"].sum()
+    _assert_result(out, exp, ["s_state"], [("ss_ext_sales_price", "float")])
+
+
+def test_run_all_executes_every_query(files):
+    outs = tpcds.run_all(files)
+    assert len(outs) == len(tpcds.QUERIES) >= 21
+    for name, t in outs.items():
+        assert t.num_rows >= 0, name
