@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Nightly pipeline — the analog of the reference's ci/nightly-build.sh:25-30
+# (mvn deploy of the cuda-classified jar after a full build): build, full
+# test suite, driver-contract checks, benchmarks, and on-TPU validation,
+# with every artifact dropped under target/nightly/ for archival.
+#
+# Usage: ci/nightly.sh [--no-tpu]   (--no-tpu skips chip-bound stages)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT=target/nightly
+mkdir -p "$OUT"
+
+echo "== build + wheel + provenance =="
+bash ci/premerge.sh --skip-tests
+
+echo "== full CPU suite (8-device virtual mesh) =="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q | tee "$OUT/pytest.log"
+
+echo "== multichip dryrun (driver contract) =="
+env XLA_FLAGS= JAX_PLATFORMS= python __graft_entry__.py dryrun 8 \
+    | tee "$OUT/dryrun.log"
+
+if [[ "${1:-}" != "--no-tpu" ]]; then
+    echo "== headline benchmark (real chip) =="
+    python bench.py > "$OUT/bench.json" || true
+    tail -1 "$OUT/bench.json"
+
+    echo "== on-TPU validation sweep =="
+    python tools/tpu_check.py "$OUT/tpu_check.json" || true
+
+    echo "== SF1 scan benchmark =="
+    python tools/scan_bench.py 6000000 "$OUT/scan_bench.json" || true
+fi
+
+cp -f target/dist/*.whl "$OUT"/ 2>/dev/null || true
+cp -f target/version-info.properties "$OUT"/ 2>/dev/null || true
+echo "nightly artifacts in $OUT/:"
+ls -la "$OUT"

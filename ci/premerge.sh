@@ -15,6 +15,20 @@ make -C spark_rapids_jni_tpu/native -s -j"$(nproc)"
 echo "== build provenance =="
 python ci/build_info.py
 
+if command -v javac >/dev/null 2>&1; then
+    echo "== java tier (compiled BEFORE the wheel so classes embed) =="
+    CLASSDIR=spark_rapids_jni_tpu/java_classes
+    rm -rf "$CLASSDIR"                   # no orphaned .class files
+    mkdir -p "$CLASSDIR"
+    [[ -f "$CLASSDIR/__init__.py" ]] || cat > "$CLASSDIR/__init__.py" <<'PYEOF'
+"""Compiled Java tier (present only when the wheel was built with a JDK —
+the reference jar's .class payload analog, pom.xml:450-471)."""
+PYEOF
+    javac -d "$CLASSDIR" $(find java -name '*.java')
+else
+    echo "== java tier: no javac in environment, skipped =="
+fi
+
 echo "== wheel packaging (jar-with-embedded-.so analog) =="
 python -m pip wheel . --no-deps --no-build-isolation -q -w target/dist
 python - <<'PYEOF'
@@ -25,14 +39,6 @@ for so in ("native/libsrjt.so", "native/libsrjt_parquet.so"):
     assert any(n.endswith(so) for n in names), f"{so} missing from wheel"
 print(f"wheel OK: {w}")
 PYEOF
-
-if command -v javac >/dev/null 2>&1; then
-    echo "== java tier =="
-    mkdir -p target/java-classes
-    javac -d target/java-classes $(find java -name '*.java')
-else
-    echo "== java tier: no javac in environment, skipped =="
-fi
 
 if [[ "${1:-}" != "--skip-tests" ]]; then
     echo "== tests =="

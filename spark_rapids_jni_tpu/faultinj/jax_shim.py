@@ -16,6 +16,11 @@ site name          patched seam                                CUDA analog
 ``jax.execute``     ``pxla.ExecuteReplicated.__call__``         cuLaunchKernel
 =================  ==========================================  ============
 
+While installed, JAX's C++ dispatch fastpath is additionally disabled
+(``pjit._get_fastpath_data`` → None) so the ``jax.execute`` seam sees
+REPEAT executions of cached signatures too — parity with CUPTI, which sees
+every call.  See :func:`install` for the cost model.
+
 Rules use the same JSON schema (percent / interceptionCount /
 injectionType, ``faultinj/README.md:104-141``) keyed by the site names
 above (or ``"*"``).  ``substitute`` is not meaningful at this layer (there
@@ -55,10 +60,16 @@ def install() -> list[str]:
     """Patch the JAX seams (idempotent).  Returns the site names active.
 
     Caches are cleared so existing executables re-enter the Python dispatch
-    path.  Known limitation vs CUPTI: once a computation has executed, JAX's
-    C++ fastpath dispatches cache hits without touching Python, so repeat
-    executions of the *same* jitted signature bypass the ``jax.execute``
-    site — every compile, transfer, and first execution is still seen.
+    path, AND the C++ fastpath is disabled for the install's duration:
+    ``pjit._get_fastpath_data`` is patched to return None, so the C++ pjit
+    cache never stores an entry and EVERY execution — including repeats of
+    an already-compiled signature — dispatches through Python and hits the
+    ``jax.execute`` seam.  This closes the round-2 gap vs CUPTI (which sees
+    every call, ``faultinj.cu:125-131``): a long-running executor's steady
+    state is exactly repeat executions.  Documented cost: Python dispatch
+    per call (~0.1-1 ms) instead of the C++ fastpath while installed;
+    ``uninstall`` restores full-speed dispatch (the bypassed cache simply
+    repopulates on the next call).
     """
     with _LOCK:
         if _PATCHED:
@@ -71,11 +82,21 @@ def install() -> list[str]:
         import jax._src.compiler as _compiler
         from jax._src.interpreters import pxla as _pxla
         import jax._src.dispatch as _dispatch
+        import jax._src.pjit as _pjit
         orig_compile = _compiler.backend_compile
         orig_call = _pxla.ExecuteReplicated.__call__
         orig_put = _dispatch.device_put_p.impl
+        orig_fastpath = _pjit._get_fastpath_data
 
         jax.clear_caches()
+
+        @functools.wraps(orig_fastpath)
+        def no_fastpath(*a, **k):
+            return None     # nothing cached ⇒ every call re-enters Python
+
+        _pjit._get_fastpath_data = no_fastpath
+        _PATCHED["jax._fastpath_off"] = (_pjit, "_get_fastpath_data",
+                                         orig_fastpath)
 
         @functools.wraps(orig_compile)
         def compile_shim(*a, **k):

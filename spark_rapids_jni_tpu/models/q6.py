@@ -7,10 +7,12 @@
       AND l_discount BETWEEN 0.05 AND 0.07
       AND l_quantity < 24
 
-TPU-first shape: the Parquet scan decodes on host (``parquet.decode``), and
-the predicate + multiply + masked sum is ONE fused jitted program — the
-filter never compacts (``ops.filter.mask_table`` discipline), so the whole
-query is a single static-shaped VPU pass over the four columns.
+TPU-first shape: the Parquet scan decodes ON DEVICE for the fast-path
+column shapes (``parquet.device_scan``: PLAIN bitcast / dictionary gather /
+def-level expansion as jitted ops over the raw page bytes; host fallback
+otherwise), and the predicate + multiply + masked sum is ONE fused jitted
+program — the filter never compacts (``ops.filter.mask_table`` discipline),
+so the whole query is a single static-shaped VPU pass over the four columns.
 """
 
 from __future__ import annotations
@@ -21,7 +23,6 @@ import jax
 import jax.numpy as jnp
 
 from ..column import Table
-from ..parquet import decode
 
 COLUMNS = ["l_quantity", "l_extendedprice", "l_discount", "l_shipdate"]
 
@@ -39,7 +40,8 @@ def q6_kernel(quantity, extendedprice, discount, shipdate,
 
 def run(file_bytes: bytes, date_lo_days: int, date_hi_days: int):
     """Scan a lineitem parquet file and compute Q6 revenue on device."""
-    table = decode.read_table(file_bytes, columns=COLUMNS)
+    from ..parquet import device_scan
+    table = device_scan.scan_table(file_bytes, columns=COLUMNS)
     q, ep, disc, ship = (table[i].values() for i in range(4))
     revenue, matched = q6_kernel(q, ep, disc, ship,
                                  jnp.int32(date_lo_days),

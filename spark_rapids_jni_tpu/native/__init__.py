@@ -109,6 +109,10 @@ def _bind(lib: ctypes.CDLL) -> None:
     _sig(lib, "srjt_to_rows_device", vp, [vp])
     _sig(lib, "srjt_from_rows_device", vp, [vp, vp, vp, i32])
 
+    # snappy (native/snappy_native.cpp)
+    _sig(lib, "srjt_snappy_decompress", _c.c_long,
+         [_c.c_char_p, _c.c_long, _c.c_char_p, _c.c_long])
+
 
 def load() -> Optional[ctypes.CDLL]:
     """Load (building if needed) libsrjt.so; None if unavailable."""

@@ -1,0 +1,89 @@
+// Native raw-Snappy block decompressor (no framing — the format Parquet
+// data pages use).  The reference gets Snappy through libcudf's nvcomp
+// integration (SURVEY §2.9; nvcomp ships in the reference jar,
+// pom.xml:462-469); here the host staging step runs native so SF-scale
+// page decompression is not Python-rate-bound (the pure-Python fallback in
+// parquet/snappy.py decodes ~1-5 MB/s; this runs at memcpy-class rates).
+//
+// Format: little-endian varint uncompressed length, then tagged elements —
+// low two tag bits select literal / 1-byte-offset / 2-byte-offset /
+// 4-byte-offset copy (public snappy format_description.txt).
+//
+// Implemented from the format description, hardened for untrusted input:
+// every read and write is bounds-checked; overlapping copies advance one
+// byte at a time (the format allows offset < length for RLE-style runs).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Returns the number of bytes written into dst, or a negative error code:
+//   -1 truncated/garbled input, -2 dst_len does not match the stream's own
+//   uncompressed-length varint, -3 copy offset out of range.
+long srjt_snappy_decompress(const unsigned char* src, long src_len,
+                            unsigned char* dst, long dst_len) {
+  long ip = 0;
+  // uncompressed-length varint
+  uint64_t expect = 0;
+  int shift = 0;
+  while (true) {
+    if (ip >= src_len || shift > 35) return -1;
+    unsigned char b = src[ip++];
+    expect |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+  }
+  if (static_cast<uint64_t>(dst_len) != expect) return -2;
+
+  long op = 0;
+  while (ip < src_len) {
+    unsigned char tag = src[ip++];
+    unsigned kind = tag & 3u;
+    if (kind == 0) {                       // literal
+      long len = (tag >> 2) + 1;
+      if (len > 60) {
+        int extra = len - 60;              // 1..4 length bytes follow
+        if (ip + extra > src_len) return -1;
+        uint32_t l = 0;
+        for (int k = 0; k < extra; ++k) l |= uint32_t(src[ip + k]) << (8 * k);
+        ip += extra;
+        len = long(l) + 1;
+      }
+      if (ip + len > src_len || op + len > dst_len) return -1;
+      std::memcpy(dst + op, src + ip, size_t(len));
+      ip += len;
+      op += len;
+      continue;
+    }
+    long len, off;
+    if (kind == 1) {                       // copy, 1-byte offset
+      if (ip >= src_len) return -1;
+      len = ((tag >> 2) & 7) + 4;
+      off = (long(tag >> 5) << 8) | src[ip++];
+    } else if (kind == 2) {                // copy, 2-byte offset
+      if (ip + 2 > src_len) return -1;
+      len = (tag >> 2) + 1;
+      off = long(src[ip]) | (long(src[ip + 1]) << 8);
+      ip += 2;
+    } else {                               // copy, 4-byte offset
+      if (ip + 4 > src_len) return -1;
+      len = (tag >> 2) + 1;
+      off = long(src[ip]) | (long(src[ip + 1]) << 8)
+          | (long(src[ip + 2]) << 16) | (long(src[ip + 3]) << 24);
+      ip += 4;
+    }
+    if (off <= 0 || off > op) return -3;
+    if (op + len > dst_len) return -1;
+    if (off >= len) {
+      std::memcpy(dst + op, dst + op - off, size_t(len));
+      op += len;
+    } else {
+      // overlapping run: byte-at-a-time (source window re-reads output)
+      for (long k = 0; k < len; ++k, ++op) dst[op] = dst[op - off];
+    }
+  }
+  return (op == dst_len) ? op : -1;
+}
+
+}  // extern "C"

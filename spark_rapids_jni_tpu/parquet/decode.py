@@ -122,9 +122,30 @@ def _decompress(data: bytes, codec: int, uncompressed_size: int) -> bytes:
     if codec == CODEC_SNAPPY:
         if _snappy is not None:          # optional C accelerator
             return _snappy.decompress(data)
+        out = _native_snappy(data, uncompressed_size)
+        if out is not None:              # in-tree native decoder (libsrjt)
+            return out
         from . import snappy as _pysnappy
         return _pysnappy.decompress(data, expected_size=uncompressed_size)
     raise NotImplementedError(f"unsupported parquet codec {codec}")
+
+
+def _native_snappy(data: bytes, uncompressed_size: int):
+    """Raw-snappy via the in-tree native lib; None if unavailable/invalid."""
+    import ctypes
+    from .. import native as _native
+    lib = _native.load()
+    if lib is None or uncompressed_size is None:
+        return None
+    try:
+        fn = lib.srjt_snappy_decompress   # bound in native._bind()
+    except AttributeError:
+        return None                      # stale .so without the symbol
+    out = ctypes.create_string_buffer(uncompressed_size)
+    rc = fn(data, len(data), out, uncompressed_size)
+    if rc != uncompressed_size:
+        return None                      # fall through to the pure decoder
+    return out.raw
 
 
 def _bit_width(max_level: int) -> int:

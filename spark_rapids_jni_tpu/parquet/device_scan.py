@@ -1,0 +1,287 @@
+"""Device-side Parquet scan (BASELINE config #2 — "GB/s columnar scan").
+
+Round 2 decoded every page byte in host NumPy loops and uploaded finished
+columns (`decode.py`); the reference's scan is a GPU engine (libcudf decode
+built into the artifact, ``build-libcudf.xml:48-64``).  This module moves
+the byte-level decode ONTO the chip for the hot shapes:
+
+  host (staging, like the reference's host buffers):
+      footer/thrift parse → page walk → decompression (native snappy in
+      ``libsrjt.so``) → concatenate raw PLAIN payloads / host-decode tiny
+      run-length metadata (def levels, dictionary indices' RLE headers)
+  device (one jitted program per column):
+      PLAIN bitcast u8 → typed lanes  (f64 → u32 bit pairs, the Column
+      invariant — no f64 arithmetic anywhere)
+      dictionary index gather          (typed dict values resident)
+      def-level expansion              (cumsum positions + masked gather)
+
+Columns outside the fast path (strings, BOOLEAN bit-packs, INT96, DELTA_*,
+nested) fall back to the host decoder transparently — correctness first,
+the fast path covers the scan-heavy analytics shapes (TPC-H q6's four
+columns, TPC-DS measure columns).
+
+``scan_table`` mirrors ``decode.read_table`` and is differentially tested
+against it (tests/test_device_scan.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import struct as _struct
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..column import Column, Table
+from ..utils.tracing import traced
+from . import decode as D
+from .footer import extract_footer_bytes
+from .thrift import parse_struct
+
+_PLAIN_PHYS = {D.PT_INT32: 4, D.PT_INT64: 8, D.PT_FLOAT: 4, D.PT_DOUBLE: 8}
+
+
+def _walk_chunk_raw(file_bytes: bytes, chunk, max_def: int, max_rep: int):
+    """Page walk that KEEPS raw PLAIN payload bytes (or dictionary+indices)
+    instead of decoding values.  Returns None when the chunk needs the
+    host decoder (unsupported physical type / encoding / nesting)."""
+    md = chunk.get(D.CC.META_DATA)
+    phys = md.get(D.CMD.TYPE)
+    if phys not in _PLAIN_PHYS or max_rep > 0:
+        return None
+    codec = md.get(D.CMD.CODEC, 0)
+    num_values = md.get(D.CMD.NUM_VALUES)
+    start = md.get(D.CMD.DATA_PAGE_OFFSET)
+    dict_off = md.get(D.CMD.DICT_PAGE_OFFSET)
+    if dict_off is not None and dict_off < start:
+        start = dict_off
+    total = md.get(D.CMD.TOTAL_COMPRESSED_SIZE)
+    stream = D._PageStream(file_bytes[start:start + total], codec)
+
+    dictionary = None
+    payloads, idx_parts, def_parts, ns = [], [], [], []
+    decoded = 0
+    while decoded < num_values:
+        header, raw = stream.next_page()
+        ptype = header.get(D.PH.TYPE)
+        usize = header.get(D.PH.UNCOMPRESSED_SIZE)
+        if ptype == D.PAGE_DICTIONARY:
+            dph = header.get(D.PH.DICT_PAGE)
+            data = D._decompress(raw, codec, usize)
+            dictionary = np.frombuffer(
+                data, dtype=D._PHYS_NP[phys], count=dph.get(D.DPH.NUM_VALUES))
+            continue
+        if ptype == D.PAGE_DATA:
+            dph = header.get(D.PH.DATA_PAGE)
+            n = dph.get(D.DPH.NUM_VALUES)
+            enc = dph.get(D.DPH.ENCODING)
+            data = D._decompress(raw, codec, usize)
+            pos = 0
+            defs = None
+            if max_def > 0:
+                (ln,) = _struct.unpack_from("<I", data, pos)
+                pos += 4
+                defs = D.decode_rle_bitpacked_hybrid(
+                    data[pos:pos + ln], D._bit_width(max_def), n)
+                pos += ln
+            page_vals = data[pos:]
+        elif ptype == D.PAGE_DATA_V2:
+            dph = header.get(D.PH.DATA_PAGE_V2)
+            n = dph.get(D.DPH2.NUM_VALUES)
+            enc = dph.get(D.DPH2.ENCODING)
+            dl_len = dph.get(D.DPH2.DEF_LEVELS_BYTE_LENGTH, 0)
+            body = raw[dl_len:]
+            if dph.get(D.DPH2.IS_COMPRESSED, True):
+                body = D._decompress(body, codec, usize - dl_len)
+            defs = None
+            if max_def > 0 and dl_len:
+                defs = D.decode_rle_bitpacked_hybrid(
+                    raw[:dl_len], D._bit_width(max_def), n)
+            page_vals = body
+        else:
+            continue
+
+        n_present = n if defs is None else int((defs == max_def).sum())
+        if enc == D.ENC_PLAIN:
+            payloads.append(page_vals[:n_present * _PLAIN_PHYS[phys]])
+            idx_parts.append(None)
+        elif enc in (D.ENC_PLAIN_DICTIONARY, D.ENC_RLE_DICTIONARY):
+            if dictionary is None:
+                return None
+            bw = page_vals[0]
+            idx_parts.append(D.decode_rle_bitpacked_hybrid(
+                page_vals[1:], bw, n_present).astype(np.int32))
+            payloads.append(None)
+        else:
+            return None
+        def_parts.append(defs)
+        ns.append(n)
+        decoded += n
+
+    has_plain = any(p is not None for p in payloads)
+    has_dict = any(i is not None for i in idx_parts)
+    if has_plain and has_dict:
+        return None                  # mixed-encoding chunk: host fallback
+    n_total = int(sum(ns))
+    valid = None
+    if max_def > 0 and any(d is not None for d in def_parts):
+        valid = np.concatenate(
+            [d == max_def if d is not None else np.ones(k, bool)
+             for d, k in zip(def_parts, ns)])
+        if valid.all():
+            valid = None
+    if has_dict:
+        return ("dict", phys, dictionary, np.concatenate(idx_parts),
+                valid, n_total)
+    payload = b"".join(payloads)
+    return ("plain", phys, None, payload, valid, n_total)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _device_plain(phys: int, n_total: int, raw: jnp.ndarray,
+                  valid: Optional[jnp.ndarray]):
+    """u8 payload [k*itemsize] → typed [n_total] (+ def-level expansion).
+
+    FLOAT64 lands as u32 [n, 2] bit pairs (the Column invariant) — the
+    decode is pure byte movement, exact on every backend."""
+    size = _PLAIN_PHYS[phys]
+    vals8 = raw.reshape(-1, size)
+    if phys == D.PT_DOUBLE:
+        # flat u32 then reshape: the direct [k,2,4]→[k,2] bitcast costs
+        # ~15× more on TPU (narrow-minor layout; measured round 3)
+        typed = jax.lax.bitcast_convert_type(
+            raw.reshape(-1, 4), jnp.uint32).reshape(-1, 2)  # [k, 2]
+    elif phys == D.PT_FLOAT:
+        typed = jax.lax.bitcast_convert_type(vals8, jnp.float32)
+    elif phys == D.PT_INT64:
+        typed = jax.lax.bitcast_convert_type(vals8, jnp.int64)
+    else:
+        typed = jax.lax.bitcast_convert_type(vals8, jnp.int32)
+    if valid is None:
+        return typed
+    if typed.shape[0] == 0:        # all-null column: nothing to gather
+        shape = (valid.shape[0],) + typed.shape[1:]
+        return jnp.zeros(shape, typed.dtype)
+    # def-level expansion: present value i sits at the i-th valid slot
+    pos = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    pos = jnp.clip(pos, 0, typed.shape[0] - 1)
+    full = typed[pos]
+    zero = jnp.zeros((), typed.dtype)
+    if typed.ndim == 2:
+        return jnp.where(valid[:, None], full, zero)
+    return jnp.where(valid, full, zero)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _device_dict(phys: int, dict_vals: jnp.ndarray, idx: jnp.ndarray,
+                 valid: Optional[jnp.ndarray]):
+    """Dictionary gather on device (+ def-level expansion)."""
+    if valid is None:
+        return dict_vals[idx]
+    if idx.shape[0] == 0:          # all-null column: nothing to gather
+        shape = (valid.shape[0],) + dict_vals.shape[1:]
+        return jnp.zeros(shape, dict_vals.dtype)
+    pos = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    pos = jnp.clip(pos, 0, idx.shape[0] - 1)
+    full = dict_vals[idx[pos]]
+    zero = jnp.zeros((), dict_vals.dtype)
+    if full.ndim == 2:
+        return jnp.where(valid[:, None], full, zero)
+    return jnp.where(valid, full, zero)
+
+
+def _upload_dict(phys: int, dictionary: np.ndarray) -> jnp.ndarray:
+    if phys == D.PT_DOUBLE:
+        from ..utils import f64bits
+        return jnp.asarray(f64bits.np_to_bits(dictionary))
+    return jnp.asarray(dictionary)
+
+
+def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
+    """All row groups of one column via the device path; None → fall back."""
+    parts = []
+    for chunk in chunks:
+        part = _walk_chunk_raw(file_bytes, chunk, leaf.max_def, leaf.max_rep)
+        if part is None:
+            return None
+        parts.append(part)
+    kinds = {p[0] for p in parts}
+    physes = {p[1] for p in parts}
+    if len(kinds) > 1 or len(physes) > 1:
+        return None
+    kind, phys = parts[0][0], parts[0][1]
+    dt = leaf.logical_dtype()
+    if dt.is_decimal or dt.id == T.TypeId.LIST:
+        return None                        # decimal widening: host path
+
+    valid_np = None
+    if any(p[4] is not None for p in parts):
+        valid_np = np.concatenate(
+            [p[4] if p[4] is not None else np.ones(p[5], bool)
+             for p in parts])
+    jvalid = None if valid_np is None else jnp.asarray(valid_np)
+
+    if kind == "plain":
+        payload = b"".join(p[3] for p in parts)
+        n_total = sum(p[5] for p in parts)
+        raw = jnp.asarray(np.frombuffer(payload, dtype=np.uint8))
+        data = _device_plain(phys, n_total, raw, jvalid)
+    else:
+        dicts = [p[2] for p in parts]
+        base = dicts[0]
+        if any(d is not base and not np.array_equal(d, base)
+               for d in dicts[1:]):
+            # per-row-group dictionaries differ: rebase indices
+            idx_all = []
+            offset = 0
+            merged = np.concatenate(dicts)
+            for p in parts:
+                idx_all.append(p[3] + offset)
+                offset += p[2].shape[0]
+            dict_dev = _upload_dict(phys, merged)
+            idx = jnp.asarray(np.concatenate(idx_all))
+        else:
+            dict_dev = _upload_dict(phys, base)
+            idx = jnp.asarray(np.concatenate([p[3] for p in parts]))
+        data = _device_dict(phys, dict_dev, idx, jvalid)
+    storage = dt.storage
+    if dt.id != T.TypeId.FLOAT64 and data.dtype != storage:
+        data = data.astype(storage)        # logical narrowing (date32 etc.)
+    return Column(dt, data, validity=jvalid)
+
+
+@traced("parquet_scan_table_device")
+def scan_table(file_bytes: bytes,
+               columns: Optional[list[str]] = None) -> Table:
+    """``decode.read_table`` with the device fast path per column."""
+    meta = parse_struct(extract_footer_bytes(file_bytes))
+    leaves = D._leaf_schema_elements(meta)
+    names = [leaf.name for leaf in leaves]
+    want = list(range(len(leaves))) if columns is None else [
+        names.index(c) for c in columns]
+    groups = meta.get(D.FMD.ROW_GROUPS)
+    chunk_lists = {i: [] for i in want}
+    for rg in groups.values:
+        chunks = rg.get(D.RG.COLUMNS).values
+        for i in want:
+            chunk_lists[i].append(chunks[i])
+
+    cols = []
+    fallback: list[int] = []
+    by_index: dict[int, Column] = {}
+    for i in want:
+        col = scan_column_device(file_bytes, chunk_lists[i], leaves[i])
+        if col is None:
+            fallback.append(i)
+        else:
+            by_index[i] = col
+    if fallback:
+        host = D.read_table(file_bytes, columns=[names[i] for i in fallback])
+        for j, i in enumerate(fallback):
+            by_index[i] = host[j]
+    for i in want:
+        cols.append(by_index[i])
+    return Table(cols)

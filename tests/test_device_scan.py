@@ -1,0 +1,122 @@
+"""Device-side Parquet scan vs the host decoder — byte-exact differential
+across encodings, codecs, nulls, dictionaries, and fallback columns."""
+
+import io
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_jni_tpu.parquet import decode, device_scan
+
+RNG = np.random.default_rng(17)
+
+
+def write(t: pa.Table, **kw) -> bytes:
+    buf = io.BytesIO()
+    pq.write_table(t, buf, **kw)
+    return buf.getvalue()
+
+
+def assert_tables_match(dev, host):
+    assert dev.num_columns == host.num_columns
+    for a, b in zip(dev.columns, host.columns):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a.data), np.asarray(b.data))
+        va = np.asarray(a.validity_or_true())
+        vb = np.asarray(b.validity_or_true())
+        np.testing.assert_array_equal(va, vb)
+
+
+@pytest.mark.parametrize("compression", ["NONE", "SNAPPY"])
+@pytest.mark.parametrize("use_dictionary", [False, True])
+def test_fixed_width_matrix(compression, use_dictionary):
+    n = 20_000
+    t = pa.table({
+        "i32": pa.array(RNG.integers(-10**9, 10**9, n, dtype=np.int32)),
+        "i64": pa.array(RNG.integers(-10**18, 10**18, n, dtype=np.int64)),
+        "f32": pa.array(RNG.standard_normal(n).astype(np.float32)),
+        "f64": pa.array(RNG.standard_normal(n)),
+        # low cardinality: dictionary encoding stays dictionary-encoded
+        "lowcard": pa.array(RNG.integers(0, 50, n, dtype=np.int64)),
+    })
+    raw = write(t, compression=compression, use_dictionary=use_dictionary,
+                row_group_size=6000)
+    assert_tables_match(device_scan.scan_table(raw), decode.read_table(raw))
+
+
+def test_nulls_def_level_expansion():
+    n = 9000
+    vals = RNG.standard_normal(n)
+    mask = RNG.random(n) < 0.8
+    arr = pa.array(pd.array(np.where(mask, vals, np.nan),
+                            dtype="float64").to_numpy(),
+                   mask=~mask)
+    i64 = pa.array(RNG.integers(0, 10**6, n, dtype=np.int64),
+                   mask=RNG.random(n) < 0.1)
+    t = pa.table({"f64n": arr, "i64n": i64})
+    raw = write(t, compression="SNAPPY", use_dictionary=False,
+                row_group_size=2500)
+    assert_tables_match(device_scan.scan_table(raw), decode.read_table(raw))
+
+
+def test_mixed_fallback_columns():
+    # strings + date32 + f64: strings fall back to the host decoder, the
+    # rest ride the device path — column order must be preserved
+    n = 5000
+    t = pa.table({
+        "s": pa.array([f"row{i % 97}" for i in range(n)]),
+        "d": pa.array(RNG.integers(8000, 12000, n, dtype=np.int32),
+                      pa.date32()),
+        "v": pa.array(RNG.standard_normal(n)),
+    })
+    raw = write(t, compression="SNAPPY")
+    assert_tables_match(device_scan.scan_table(raw), decode.read_table(raw))
+
+
+def test_column_selection_order():
+    n = 1000
+    t = pa.table({
+        "a": pa.array(np.arange(n, dtype=np.int64)),
+        "b": pa.array(np.arange(n, dtype=np.int32) * 2),
+        "c": pa.array(RNG.standard_normal(n)),
+    })
+    raw = write(t, use_dictionary=False)
+    dev = device_scan.scan_table(raw, columns=["c", "a"])
+    host = decode.read_table(raw, columns=["c", "a"])
+    assert_tables_match(dev, host)
+
+
+def test_q6_pipeline_via_device_scan():
+    from tests.test_parquet_decode import make_lineitem
+    from spark_rapids_jni_tpu.models import q6
+    raw, df = make_lineitem(12_000)
+    lo, hi = 8766, 8766 + 365
+    table = device_scan.scan_table(raw, columns=q6.COLUMNS)
+    qv, ep, disc, ship = (table[i].values() for i in range(4))
+    import jax.numpy as jnp
+    revenue, matched = q6.q6_kernel(qv, ep, disc, ship,
+                                    jnp.int32(lo), jnp.int32(hi))
+    m = ((df.l_shipdate >= lo) & (df.l_shipdate < hi)
+         & (df.l_discount >= 0.05) & (df.l_discount <= 0.07)
+         & (df.l_quantity < 24))
+    expect = float((df.l_extendedprice[m] * df.l_discount[m]).sum())
+    assert int(matched) == int(m.sum())
+    np.testing.assert_allclose(float(revenue), expect, rtol=1e-9)
+
+
+def test_all_null_column():
+    # a fully-null optional column has ZERO present values — the def-level
+    # expansion must produce an all-null column, not crash on an empty
+    # gather (round-3 review finding)
+    n = 100
+    t = pa.table({
+        "allnull": pa.array([None] * n, pa.float64()),
+        "ok": pa.array(np.arange(n, dtype=np.int64)),
+    })
+    for use_dict in (False, True):
+        raw = write(t, use_dictionary=use_dict)
+        assert_tables_match(device_scan.scan_table(raw),
+                            decode.read_table(raw))

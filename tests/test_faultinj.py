@@ -214,3 +214,50 @@ def test_shim_uninstall_restores(shim):
     inj = faultinj.get_injector()
     inj._rules = {}
     assert _device_work() >= 0
+
+
+def test_shim_sees_repeat_cached_executions(tmp_path, shim):
+    # CUPTI parity (faultinj.cu:125-131): the steady state of a long-running
+    # executor is REPEAT executions of an already-compiled signature.  With
+    # the C++ fastpath active those bypass Python entirely; the shim
+    # disables it, so a fault armed AFTER several warm executions must still
+    # fire on the next (cached) call.
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        return jnp.sum(x * 2)
+
+    x = jnp.arange(128)
+    for _ in range(3):                 # compile + warm repeats, no faults
+        assert int(step(x)) == int(np.arange(128).sum() * 2)
+    faultinj.enable(write_cfg(tmp_path, {
+        "seed": 1,
+        "sites": {"jax.execute": {"percent": 100,
+                                  "interceptionCount": 1,
+                                  "injectionType": "device_error"}}}))
+    with pytest.raises(InjectedDeviceError):
+        step(x)                        # cached signature — must still trap
+    assert int(step(x)) == int(np.arange(128).sum() * 2)  # budget spent
+
+
+def test_executor_recovers_mid_query_on_cached_execution(tmp_path, shim):
+    # kill a CACHED execution mid-"query" and recover via the retry policy
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def stage(x):
+        return jnp.cumsum(x)
+
+    x = jnp.arange(64)
+    warm = np.asarray(stage(x))        # compiled + executed once
+    faultinj.enable(write_cfg(tmp_path, {
+        "seed": 1,
+        "sites": {"jax.execute": {"percent": 100,
+                                  "interceptionCount": 1,
+                                  "injectionType": "oom"}}}))
+    ex = ResilientExecutor(max_retries=2)
+    out = ex.submit(lambda: np.asarray(stage(x)))
+    np.testing.assert_array_equal(out, warm)
