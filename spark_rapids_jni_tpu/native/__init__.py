@@ -127,7 +127,18 @@ def load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
             return None
-        _bind(lib)
+        try:
+            _bind(lib)
+        except AttributeError:
+            # stale .so predating a newly-bound symbol: rebuild once and
+            # retry — crashing every native consumer is not an option
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+                _bind(lib)
+            except (OSError, AttributeError):
+                return None
         _lib = lib
         return _lib
 

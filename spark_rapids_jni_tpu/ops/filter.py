@@ -57,7 +57,9 @@ def gather(table: Table, idx: jnp.ndarray) -> Table:
 
 def apply_boolean_mask(table: Table, mask: jnp.ndarray) -> Table:
     """Keep rows where mask is True (compacting; one host sync for the count)."""
-    idx = jnp.nonzero(mask)[0]   # host sync happens here (dynamic size)
+    from ..utils import syncs
+    n_keep = syncs.scalar(jnp.sum(mask))   # counted host sync (dynamic size)
+    idx = jnp.nonzero(mask, size=n_keep)[0]
     return gather(table, idx)
 
 

@@ -156,7 +156,8 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
             skeys.append(col.data)
             svalid.append(col.validity)
     seg_ids = _segment_ids(skeys, svalid)
-    num_segments = int(seg_ids[-1]) + 1   # scalar sync (group count)
+    from ..utils import syncs
+    num_segments = syncs.scalar(seg_ids[-1]) + 1   # scalar sync (group count)
 
     # one representative row per segment for the key columns
     head_pos = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int32), seg_ids,

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..column import Column, Table
+from ..utils import syncs
 from .filter import gather
 
 
@@ -55,7 +56,7 @@ def join_indices(left: Column, right: Column,
         rank = jnp.where(rvalid, 0, 1)[r_order]
         rr = jnp.lexsort((r_sorted, rank))
         r_order, r_sorted = r_order[rr], r_sorted[rr]
-        n_valid_r = int(jnp.sum(rvalid))
+        n_valid_r = syncs.scalar(jnp.sum(rvalid))
         r_order, r_sorted = r_order[:n_valid_r], r_sorted[:n_valid_r]
 
     lo = jnp.searchsorted(r_sorted, ldata, side="left")
@@ -74,7 +75,7 @@ def join_indices(left: Column, right: Column,
     else:
         out_counts = counts
 
-    total = int(jnp.sum(out_counts))          # scalar sync (pair count)
+    total = syncs.scalar(jnp.sum(out_counts))     # scalar sync (pair count)
     starts = jnp.cumsum(out_counts) - out_counts
     pair_ids = jnp.arange(total, dtype=jnp.int64)
     # row of each output pair: inverse of starts (searchsorted right)

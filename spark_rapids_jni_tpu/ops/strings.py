@@ -53,7 +53,7 @@ def byte_matrix(col: Column, width: Optional[int] = None):
     n = col.num_rows
     lens = _lengths(col)
     if width is None:
-        width = int(jnp.max(lens)) if n else 0
+        width = _max_len(col)
     L = max(_round_up(width, 4), 4)
     j = jnp.arange(L, dtype=jnp.int32)
     idx = col.offsets[:-1, None] + j[None, :]
@@ -64,6 +64,26 @@ def byte_matrix(col: Column, width: Optional[int] = None):
     else:
         mat = jnp.zeros((n, L), dtype=jnp.uint8)
     return mat, lens
+
+
+def _max_len(col: Column) -> int:
+    """Max string length: free from the host-mirror offsets when available,
+    one counted scalar sync otherwise — memoized on the offsets array (the
+    width is a pure function of it, and query plans re-touch the same
+    dimension columns constantly)."""
+    from ..utils import hostcache, syncs
+    if col.num_rows == 0:
+        return 0
+    hit = syncs.memo_get("strwidth", (col.offsets,))
+    if hit is not None:
+        return hit
+    host = hostcache.peek(col.offsets)
+    if host is not None:
+        width = int((host[1:] - host[:-1]).max(initial=0))
+    else:
+        width = syncs.scalar(jnp.max(_lengths(col)))
+    syncs.memo_put("strwidth", (col.offsets,), width)
+    return width
 
 
 def _u32_lanes(mat: jnp.ndarray) -> jnp.ndarray:
@@ -108,6 +128,16 @@ def dictionary_encode(col: Column) -> tuple[Column, Column]:
         return (Column(T.int32, jnp.zeros(0, jnp.int32)),
                 Column(T.string, jnp.zeros(0, jnp.uint8),
                        jnp.zeros(1, jnp.int32)))
+    # pure function of the column payload, re-touched by every groupby /
+    # window / join over the same dimension column: memoize (the distinct
+    # count sync below then happens once per column, not once per query op)
+    from ..utils import syncs
+    memo_key = (col.data, col.offsets) + (
+        (col.validity,) if col.validity is not None else ())
+    memo_tag = f"dictenc{'v' if col.validity is not None else ''}"
+    hit = syncs.memo_get(memo_tag, memo_key)
+    if hit is not None:
+        return hit
     mat, lens = byte_matrix(col)
     if col.validity is not None:
         # nulls collapse onto the zeroed key so they share one code
@@ -134,7 +164,7 @@ def dictionary_encode(col: Column) -> tuple[Column, Column]:
     # payload could decode as the empty-string group key): scatter any row
     # first, then overwrite with valid rows (invalid ones routed to a trash
     # slot).
-    ndict = int(codes_sorted[-1]) + 1          # scalar sync (distinct count)
+    ndict = syncs.scalar(codes_sorted[-1]) + 1   # scalar sync (distinct count)
     order32 = order.astype(jnp.int32)
     first_pos = jnp.zeros(ndict + 1, dtype=jnp.int32).at[
         jnp.flip(codes_sorted)].set(jnp.flip(order32))
@@ -144,7 +174,9 @@ def dictionary_encode(col: Column) -> tuple[Column, Column]:
     from .filter import _gather_column
     uniq = _gather_column(Column(col.dtype, col.data, col.offsets),
                           first_pos[:ndict])
-    return Column(T.int32, codes, validity=col.validity), uniq
+    out = (Column(T.int32, codes, validity=col.validity), uniq)
+    syncs.memo_put(memo_tag, memo_key, out)
+    return out
 
 
 def encode_shared(cols: Sequence[Column]) -> list[Column]:
@@ -179,8 +211,7 @@ def encode_shared(cols: Sequence[Column]) -> list[Column]:
 def equal_to(a: Column, b: Column) -> Column:
     """Row-wise string equality → BOOL8 column (null if either side null)."""
     la, lb = _lengths(a), _lengths(b)
-    width = int(jnp.maximum(jnp.max(la) if a.num_rows else 0,
-                            jnp.max(lb) if b.num_rows else 0))
+    width = max(_max_len(a), _max_len(b))
     ma, _ = byte_matrix(a, width)
     mb, _ = byte_matrix(b, width)
     eq = (la == lb) & jnp.all(ma == mb, axis=1)
@@ -486,7 +517,7 @@ def _search_matrix(col: Column, min_width: int):
     pattern (``byte_matrix(width=…)`` PINS the width — passing only the
     pattern length would truncate longer rows and lose matches)."""
     n = col.num_rows
-    wmax = int(jnp.max(_lengths(col))) if n else 0
+    wmax = _max_len(col)
     return byte_matrix(col, width=max(wmax, min_width, 1))
 
 

@@ -140,26 +140,25 @@ def _walk_chunk_raw(file_bytes: bytes, chunk, max_def: int, max_rep: int):
     return ("plain", phys, None, payload, valid, n_total)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def _device_plain(phys: int, n_total: int, raw: jnp.ndarray,
+@functools.partial(jax.jit, static_argnums=0)
+def _device_plain(phys: int, raw: jnp.ndarray,
                   valid: Optional[jnp.ndarray]):
-    """u8 payload [k*itemsize] → typed [n_total] (+ def-level expansion).
+    """u8 payload [k*itemsize] → typed [k] (+ def-level expansion to the
+    full row count when ``valid`` is given).
 
     FLOAT64 lands as u32 [n, 2] bit pairs (the Column invariant) — the
     decode is pure byte movement, exact on every backend."""
-    size = _PLAIN_PHYS[phys]
-    vals8 = raw.reshape(-1, size)
     if phys == D.PT_DOUBLE:
         # flat u32 then reshape: the direct [k,2,4]→[k,2] bitcast costs
         # ~15× more on TPU (narrow-minor layout; measured round 3)
         typed = jax.lax.bitcast_convert_type(
             raw.reshape(-1, 4), jnp.uint32).reshape(-1, 2)  # [k, 2]
     elif phys == D.PT_FLOAT:
-        typed = jax.lax.bitcast_convert_type(vals8, jnp.float32)
+        typed = jax.lax.bitcast_convert_type(raw.reshape(-1, 4), jnp.float32)
     elif phys == D.PT_INT64:
-        typed = jax.lax.bitcast_convert_type(vals8, jnp.int64)
+        typed = jax.lax.bitcast_convert_type(raw.reshape(-1, 8), jnp.int64)
     else:
-        typed = jax.lax.bitcast_convert_type(vals8, jnp.int32)
+        typed = jax.lax.bitcast_convert_type(raw.reshape(-1, 4), jnp.int32)
     if valid is None:
         return typed
     if typed.shape[0] == 0:        # all-null column: nothing to gather
@@ -226,9 +225,8 @@ def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
 
     if kind == "plain":
         payload = b"".join(p[3] for p in parts)
-        n_total = sum(p[5] for p in parts)
         raw = jnp.asarray(np.frombuffer(payload, dtype=np.uint8))
-        data = _device_plain(phys, n_total, raw, jvalid)
+        data = _device_plain(phys, raw, jvalid)
     else:
         dicts = [p[2] for p in parts]
         base = dicts[0]

@@ -16,28 +16,63 @@ optimization only — a miss falls back to the transfer.
 from __future__ import annotations
 
 import weakref
+from typing import Any
 
 import numpy as np
 
-# id(device_array) -> (weakref with cleanup callback, host mirror)
-_HOST: dict[int, tuple[weakref.ref, np.ndarray]] = {}
+
+class WeakIdMemo:
+    """Weak cache keyed on the IDENTITY of one or more (device) arrays.
+
+    The shared mechanism behind the host-mirror cache here and the
+    dictionary/width memos in ``utils.syncs``: entries key on ``id()`` of
+    the arrays, hold weakrefs with cleanup callbacks so values drop when
+    any keyed array is garbage-collected, and an ``is``-identity check
+    guards against id recycling.  Best-effort: non-weakref-able keys are
+    simply not cached.
+    """
+
+    def __init__(self) -> None:
+        self._d: dict[tuple, tuple] = {}
+
+    def get(self, arrays) -> Any:
+        entry = self._d.get(tuple(id(a) for a in arrays))
+        if entry is None:
+            return None
+        refs, value = entry
+        for r, a in zip(refs, arrays):
+            if r() is not a:
+                return None
+        return value
+
+    def put(self, arrays, value) -> None:
+        key = tuple(id(a) for a in arrays)
+        try:
+            refs = tuple(
+                weakref.ref(a, lambda _, k=key: self._d.pop(k, None))
+                for a in arrays)
+        except TypeError:
+            return
+        self._d[key] = (refs, value)
+
+
+_HOST = WeakIdMemo()
 
 
 def seed(device_arr, host_arr: np.ndarray) -> None:
     """Record ``host_arr`` as the host mirror of ``device_arr``."""
-    key = id(device_arr)
-    try:
-        r = weakref.ref(device_arr, lambda _, k=key: _HOST.pop(k, None))
-    except TypeError:
-        return  # not weakref-able — cache is best-effort
-    _HOST[key] = (r, host_arr)
+    _HOST.put((device_arr,), host_arr)
+
+
+def peek(device_arr):
+    """The cached host mirror, or None — never triggers a transfer."""
+    return _HOST.get((device_arr,))
 
 
 def host_i64(device_arr) -> np.ndarray:
     """Host int64 copy of a device int array, cached across calls."""
-    entry = _HOST.get(id(device_arr))
-    if entry is not None and entry[0]() is device_arr:
-        h = entry[1]
+    h = peek(device_arr)
+    if h is not None:
         return h if h.dtype == np.int64 else h.astype(np.int64)
     out = np.asarray(device_arr).astype(np.int64)
     seed(device_arr, out)

@@ -1,0 +1,59 @@
+"""Host-sync accounting + weak result caches for the two-phase ops.
+
+On the remote-TPU backend every device→host scalar sync costs ~65-110 ms,
+so a multi-op query plan's wall time is often `sync_count × tunnel RTT`
+rather than compute (round-2 evidence: Mortgage spent ~300 s producing 300
+rows).  Two countermeasures live here:
+
+* :func:`scalar` — the ONE funnel for intentional scalar syncs (group
+  counts, string widths, char totals).  It counts them, so
+  ``tools/query_bench.py`` can report a syncs-per-query figure and
+  regressions are visible.
+* weak per-array caches (:func:`memo_get` / :func:`memo_put`) keyed on
+  device-array identity — dictionary encodes and string widths are pure
+  functions of their column payloads, and analytics plans re-touch the
+  same dimension columns in every query, so the second query runs
+  sync-free for those sites.  Entries drop with the arrays (weakrefs).
+"""
+
+from __future__ import annotations
+
+# weakref handled by hostcache.WeakIdMemo
+from typing import Any
+
+_count = 0
+
+
+def scalar(x) -> int:
+    """int(x) with sync accounting — use for every intentional D2H scalar."""
+    global _count
+    _count += 1
+    return int(x)
+
+
+def sync_count() -> int:
+    return _count
+
+
+def reset_sync_count() -> int:
+    global _count
+    old, _count = _count, 0
+    return old
+
+
+# --- weak memo keyed on device-array identity (shared mechanism with the
+# host-mirror cache: utils.hostcache.WeakIdMemo) -----------------------------
+
+from .hostcache import WeakIdMemo
+
+_MEMOS: dict[str, WeakIdMemo] = {}
+
+
+def memo_get(tag: str, arrays) -> Any:
+    """Cached value for (tag, arrays) — None on miss or if any array died."""
+    memo = _MEMOS.get(tag)
+    return None if memo is None else memo.get(arrays)
+
+
+def memo_put(tag: str, arrays, value) -> None:
+    _MEMOS.setdefault(tag, WeakIdMemo()).put(arrays, value)

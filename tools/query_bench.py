@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""SF1-class TPC-DS query timing + sync accounting → QUERY_BENCH.json.
+
+BASELINE config #3 at scale: a 10M-row store_sales fact (20K items, 50
+stores, 3 years of dates) generated as snappy parquet, decoded through the
+scan path, then a representative query slice timed twice:
+
+  run 1 (cold): jit compiles + one-time dictionary/width syncs
+  run 2 (warm): steady state — compiled programs, memoized dictionary
+                encodes and string widths (``utils/syncs.py``)
+
+For each run the wall time AND the number of intentional host scalar syncs
+(the ``syncs.scalar`` funnel: group counts, filter counts, string widths,
+dictionary sizes) are recorded — the VERDICT r2 "sync-count-per-query"
+figure.  On the tunneled chip each sync costs ~65-110 ms, so warm counts
+approximate the dispatch-bound floor of a plan.
+
+Usage: python tools/query_bench.py [n_sales] [out.json]
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+
+RESULTS = {"queries": {}}
+
+
+def main():
+    n_sales = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+    out_path = sys.argv[2] if len(sys.argv) > 2 else "QUERY_BENCH.json"
+    print(f"backend: {jax.default_backend()}  n_sales: {n_sales}", flush=True)
+
+    from benchmarks import tpcds_data
+    from spark_rapids_jni_tpu.models import tpcds
+    from spark_rapids_jni_tpu.utils import syncs
+
+    t0 = time.perf_counter()
+    files = tpcds_data.generate(n_sales=n_sales, n_items=20_000,
+                                n_stores=50, seed=5)
+    gen_s = time.perf_counter() - t0
+    print(f"generated {sum(len(v) for v in files.values())/1e6:.0f} MB "
+          f"parquet in {gen_s:.1f}s", flush=True)
+
+    t0 = time.perf_counter()
+    tables = tpcds.load_tables(files)
+    # force materialization of the fact columns (uploads are lazy)
+    for c in tables["store_sales"].columns:
+        np.asarray(c.data[:1])
+    load_s = time.perf_counter() - t0
+    RESULTS["n_sales"] = n_sales
+    RESULTS["load_s"] = round(load_s, 1)
+    print(f"decode+upload: {load_s:.1f}s", flush=True)
+
+    chosen = ["q3", "q55", "q62", "q_state_rollup", "q_having"]
+    for name in chosen:
+        fn = tpcds.QUERIES[name]
+        entry = {}
+        for run in ("cold", "warm"):
+            syncs.reset_sync_count()
+            t0 = time.perf_counter()
+            out = fn(tables)
+            # materialize the result (one extra sync, counted honestly)
+            np.asarray(out[0].data[:1]) if out.num_rows else None
+            wall = time.perf_counter() - t0
+            entry[f"{run}_wall_s"] = round(wall, 2)
+            entry[f"{run}_syncs"] = syncs.reset_sync_count()
+        entry["rows_out"] = out.num_rows
+        RESULTS["queries"][name] = entry
+        print(f"{name}: cold {entry['cold_wall_s']}s "
+              f"({entry['cold_syncs']} syncs) -> warm "
+              f"{entry['warm_wall_s']}s ({entry['warm_syncs']} syncs), "
+              f"{out.num_rows} rows", flush=True)
+        # flush after every query: a worker crash on a later (heavier)
+        # query must not lose the measurements already taken
+        with open(out_path, "w") as f:
+            json.dump(RESULTS, f, indent=1)
+
+    print("wrote", out_path, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -180,7 +180,7 @@ def main():
         rev2, m2 = q6m.run(raw, lo, hi)
         e2e = time.perf_counter() - t0
         RESULTS["end_to_end_wall_s"] = round(e2e, 2)
-        RESULTS["end_to_end_gbps"] = round(col_bytes / e2e / 1e9, 3)
+        RESULTS["end_to_end_mbps"] = round(col_bytes / e2e / 1e6, 2)
         ok2 = m2 == int(m.sum())
         RESULTS["q6_api_correct"] = bool(ok2)
         print(f"end-to-end q6.run: {e2e:.2f}s wall "
