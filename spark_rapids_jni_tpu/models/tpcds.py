@@ -385,13 +385,22 @@ def q_nunique_items(tables: dict[str, Table]) -> Table:
 
 def q_having(tables: dict[str, Table], min_total: float = 1000.0) -> Table:
     """GROUP BY brand HAVING SUM(price) > threshold (Q23 HAVING shape):
-    aggregate, then filter on the aggregate."""
+    aggregate, then filter on the aggregate.
+
+    Projection pushdown (what Spark's optimizer does before the exchange):
+    this is an UNFILTERED full-fact join, so only the join key, the measure,
+    and the group column enter it — materializing all 16 joined columns at
+    SF1 allocates multiple GB of string gathers for columns the query never
+    reads (measured: it OOM-crashed the chip at 10M rows).
+    """
     ss, item = tables["store_sales"], tables["item"]
-    j = inner_join(ss, item, _col(SS_COLS, "ss_item_sk"),
-                   _col(ITEM_COLS, "i_item_sk"))
-    cols = SS_COLS + ITEM_COLS
-    rev = groupby_aggregate(j, [cols.index("i_brand_id")],
-                            [(cols.index("ss_ext_sales_price"), "sum")])
+    ssp = Table([ss[_col(SS_COLS, "ss_item_sk")],
+                 ss[_col(SS_COLS, "ss_ext_sales_price")]])
+    itp = Table([item[_col(ITEM_COLS, "i_item_sk")],
+                 item[_col(ITEM_COLS, "i_brand_id")]])
+    j = inner_join(ssp, itp, 0, 0)
+    # j columns: [ss_item_sk, price, i_item_sk, i_brand_id]
+    rev = groupby_aggregate(j, [3], [(1, "sum")])
     keep = rev[1].values() > min_total
     return sort_table(apply_boolean_mask(rev, keep), [0])
 

@@ -131,7 +131,14 @@ def load() -> Optional[ctypes.CDLL]:
             _bind(lib)
         except AttributeError:
             # stale .so predating a newly-bound symbol: rebuild once and
-            # retry — crashing every native consumer is not an option
+            # retry — crashing every native consumer is not an option.
+            # dlopen caches by pathname, so the stale mapping must be
+            # dlclosed first or the retry would rebind the old object.
+            try:
+                ctypes.CDLL(None).dlclose(ctypes.c_void_p(lib._handle))
+            except (OSError, AttributeError):
+                return None          # cannot unload — stay unavailable
+            del lib
             if not _build():
                 return None
             try:

@@ -12,8 +12,9 @@ rows).  Two countermeasures live here:
 * weak per-array caches (:func:`memo_get` / :func:`memo_put`) keyed on
   device-array identity — dictionary encodes and string widths are pure
   functions of their column payloads, and analytics plans re-touch the
-  same dimension columns in every query, so the second query runs
-  sync-free for those sites.  Entries drop with the arrays (weakrefs).
+  same dimension columns — a repeated DIRECT touch of a base-table
+  column skips its sync (post-gather copies are fresh arrays and
+  legitimately re-resolve).  Entries drop with the arrays (weakrefs).
 """
 
 from __future__ import annotations

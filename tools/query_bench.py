@@ -57,7 +57,8 @@ def main():
     RESULTS["load_s"] = round(load_s, 1)
     print(f"decode+upload: {load_s:.1f}s", flush=True)
 
-    chosen = ["q3", "q55", "q62", "q_state_rollup", "q_having"]
+    chosen = (sys.argv[3].split(",") if len(sys.argv) > 3
+              else ["q3", "q55", "q62", "q_state_rollup", "q_having"])
     for name in chosen:
         fn = tpcds.QUERIES[name]
         entry = {}
@@ -65,8 +66,10 @@ def main():
             syncs.reset_sync_count()
             t0 = time.perf_counter()
             out = fn(tables)
-            # materialize the result (one extra sync, counted honestly)
-            np.asarray(out[0].data[:1]) if out.num_rows else None
+            # materialize EVERY result column before stopping the clock
+            jax.block_until_ready([c.data for c in out.columns])
+            if out.num_rows:          # tiny real readback: block_until_ready
+                np.asarray(out[0].data[:1])   # is a no-op on the tunnel
             wall = time.perf_counter() - t0
             entry[f"{run}_wall_s"] = round(wall, 2)
             entry[f"{run}_syncs"] = syncs.reset_sync_count()
