@@ -23,7 +23,7 @@ from ..column import Column, Table
 from ..ops import (cast, fill_null, groupby_aggregate, inner_join,
                    sort_table)
 from ..ops import strings as S
-from ..parquet import decode
+from ..parquet import device_scan as decode  # device fast path, host fallback
 
 PERF_COLS = ["loan_id", "monthly_reporting_period", "current_actual_upb",
              "current_loan_delinquency_status", "servicer_name"]

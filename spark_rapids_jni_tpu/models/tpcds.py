@@ -26,7 +26,7 @@ from ..ops import (apply_boolean_mask, concat_tables, distinct,
                    left_join, mean, slice_table, sort_table)
 from ..ops import strings as S
 from ..ops import window as W
-from ..parquet import decode
+from ..parquet import device_scan as decode  # device fast path, host fallback
 
 SS_COLS = ["ss_sold_date_sk", "ss_item_sk", "ss_store_sk", "ss_quantity",
            "ss_sales_price_cents", "ss_list_price_cents",

@@ -23,7 +23,7 @@ from .. import types as T
 from ..column import Column, Table
 from ..ops import apply_boolean_mask, decimal128 as d128
 from ..ops import groupby_aggregate
-from ..parquet import decode
+from ..parquet import device_scan as decode  # device fast path, host fallback
 
 COLUMNS = ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
            "l_discount", "l_tax", "l_shipdate"]

@@ -44,14 +44,22 @@ from .thrift import parse_struct
 _PLAIN_PHYS = {D.PT_INT32: 4, D.PT_INT64: 8, D.PT_FLOAT: 4, D.PT_DOUBLE: 8}
 
 
-def _walk_chunk_raw(file_bytes: bytes, chunk, max_def: int, max_rep: int):
+def _walk_chunk_raw(file_bytes: bytes, chunk, max_def: int, max_rep: int,
+                    type_len: int = 0):
     """Page walk that KEEPS raw PLAIN payload bytes (or dictionary+indices)
     instead of decoding values.  Returns None when the chunk needs the
-    host decoder (unsupported physical type / encoding / nesting)."""
+    host decoder (unsupported physical type / encoding / nesting).
+
+    FIXED_LEN_BYTE_ARRAY chunks (width ≤ 16 — the parquet DECIMAL carrier)
+    are fixed-width too: their payload is kept raw and assembled into
+    decimal limbs on device."""
     md = chunk.get(D.CC.META_DATA)
     phys = md.get(D.CMD.TYPE)
-    if phys not in _PLAIN_PHYS or max_rep > 0:
+    is_flba = (phys == D.PT_FIXED_LEN_BYTE_ARRAY
+               and 0 < type_len <= 16)
+    if (phys not in _PLAIN_PHYS and not is_flba) or max_rep > 0:
         return None
+    width = _PLAIN_PHYS[phys] if not is_flba else type_len
     codec = md.get(D.CMD.CODEC, 0)
     num_values = md.get(D.CMD.NUM_VALUES)
     start = md.get(D.CMD.DATA_PAGE_OFFSET)
@@ -71,8 +79,13 @@ def _walk_chunk_raw(file_bytes: bytes, chunk, max_def: int, max_rep: int):
         if ptype == D.PAGE_DICTIONARY:
             dph = header.get(D.PH.DICT_PAGE)
             data = D._decompress(raw, codec, usize)
-            dictionary = np.frombuffer(
-                data, dtype=D._PHYS_NP[phys], count=dph.get(D.DPH.NUM_VALUES))
+            m = dph.get(D.DPH.NUM_VALUES)
+            if is_flba:   # fixed-width byte strings -> host limb decode
+                dictionary = D._be_decimal_to_lanes(
+                    np.frombuffer(data, np.uint8, m * type_len), type_len)
+            else:
+                dictionary = np.frombuffer(
+                    data, dtype=D._PHYS_NP[phys], count=m)
             continue
         if ptype == D.PAGE_DATA:
             dph = header.get(D.PH.DATA_PAGE)
@@ -106,7 +119,7 @@ def _walk_chunk_raw(file_bytes: bytes, chunk, max_def: int, max_rep: int):
 
         n_present = n if defs is None else int((defs == max_def).sum())
         if enc == D.ENC_PLAIN:
-            payloads.append(page_vals[:n_present * _PLAIN_PHYS[phys]])
+            payloads.append(page_vals[:n_present * width])
             idx_parts.append(None)
         elif enc in (D.ENC_PLAIN_DICTIONARY, D.ENC_RLE_DICTIONARY):
             if dictionary is None:
@@ -192,6 +205,36 @@ def _device_dict(phys: int, dict_vals: jnp.ndarray, idx: jnp.ndarray,
     return jnp.where(valid, full, zero)
 
 
+@functools.partial(jax.jit, static_argnums=0)
+def _device_flba_decimal(width: int, raw: jnp.ndarray,
+                         valid: Optional[jnp.ndarray]):
+    """FIXED_LEN_BYTE_ARRAY decimal payload (big-endian two's complement,
+    ``width`` ≤ 16 bytes) → int64 [k, 2] (lo, hi) limb pairs on device —
+    the DECIMAL128 Column payload — with sign extension and def-level
+    expansion.  Mirrors the host oracle ``decode._be_decimal_to_lanes``."""
+    b = raw.reshape(-1, width).astype(jnp.int64)          # BE bytes, [k, w]
+    neg = b[:, 0] >= 128
+    fill = jnp.where(neg, jnp.int64(0xFF), jnp.int64(0))
+
+    def byte(i):                       # little-endian byte i of the value
+        return b[:, width - 1 - i] if i < width else fill
+
+    lo = byte(0)
+    for i in range(1, 8):
+        lo = lo | (byte(i) << (8 * i))
+    hi = byte(8)
+    for i in range(9, 16):
+        hi = hi | (byte(i) << (8 * (i - 8)))
+    typed = jnp.stack([lo, hi], axis=1)                   # [k, 2]
+    if valid is None:
+        return typed
+    if typed.shape[0] == 0:
+        return jnp.zeros((valid.shape[0], 2), jnp.int64)
+    pos = jnp.clip(jnp.cumsum(valid.astype(jnp.int32)) - 1, 0,
+                   typed.shape[0] - 1)
+    return jnp.where(valid[:, None], typed[pos], jnp.int64(0))
+
+
 def _upload_dict(phys: int, dictionary: np.ndarray) -> jnp.ndarray:
     if phys == D.PT_DOUBLE:
         from ..utils import f64bits
@@ -203,7 +246,8 @@ def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
     """All row groups of one column via the device path; None → fall back."""
     parts = []
     for chunk in chunks:
-        part = _walk_chunk_raw(file_bytes, chunk, leaf.max_def, leaf.max_rep)
+        part = _walk_chunk_raw(file_bytes, chunk, leaf.max_def, leaf.max_rep,
+                               leaf.type_len or 0)
         if part is None:
             return None
         parts.append(part)
@@ -213,8 +257,11 @@ def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
         return None
     kind, phys = parts[0][0], parts[0][1]
     dt = leaf.logical_dtype()
-    if dt.is_decimal or dt.id == T.TypeId.LIST:
-        return None                        # decimal widening: host path
+    if dt.id == T.TypeId.LIST:
+        return None
+    is_flba = phys == D.PT_FIXED_LEN_BYTE_ARRAY
+    if is_flba and not dt.is_decimal:
+        return None   # non-decimal fixed-size binary (UUIDs): host path
 
     valid_np = None
     if any(p[4] is not None for p in parts):
@@ -226,7 +273,10 @@ def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
     if kind == "plain":
         payload = b"".join(p[3] for p in parts)
         raw = jnp.asarray(np.frombuffer(payload, dtype=np.uint8))
-        data = _device_plain(phys, raw, jvalid)
+        if is_flba:
+            data = _device_flba_decimal(leaf.type_len, raw, jvalid)
+        else:
+            data = _device_plain(phys, raw, jvalid)
     else:
         dicts = [p[2] for p in parts]
         base = dicts[0]
@@ -245,6 +295,11 @@ def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
             dict_dev = _upload_dict(phys, base)
             idx = jnp.asarray(np.concatenate([p[3] for p in parts]))
         data = _device_dict(phys, dict_dev, idx, jvalid)
+    if is_flba:
+        # decimal narrowing mirrors the host path: lo limb for ≤18 digits
+        if dt.id == T.TypeId.DECIMAL128:
+            return Column(dt, data, validity=jvalid)
+        return Column(dt, data[:, 0].astype(dt.storage), validity=jvalid)
     storage = dt.storage
     if dt.id != T.TypeId.FLOAT64 and data.dtype != storage:
         data = data.astype(storage)        # logical narrowing (date32 etc.)
@@ -283,3 +338,7 @@ def scan_table(file_bytes: bytes,
     for i in want:
         cols.append(by_index[i])
     return Table(cols)
+
+
+# API mirror: callers swap `from ..parquet import decode` for this module
+read_table = scan_table

@@ -120,3 +120,65 @@ def test_all_null_column():
         raw = write(t, use_dictionary=use_dict)
         assert_tables_match(device_scan.scan_table(raw),
                             decode.read_table(raw))
+
+
+def test_flba_decimals_device():
+    # FLBA DECIMAL across precisions (decimal32/64/128 narrowing), codecs,
+    # dictionary encodings, and nulls — device limbs vs host oracle
+    import decimal as pydec
+    n = 4000
+    rng = np.random.default_rng(23)
+    cents = rng.integers(-10**6, 10**6, n)
+    big = [int(x) * 10**20 for x in rng.integers(-10**9, 10**9, n)]
+    mask = rng.random(n) < 0.15
+    t = pa.table({
+        "d32": pa.array([pydec.Decimal(int(c)) / 100 for c in cents],
+                        pa.decimal128(7, 2)),
+        "d64": pa.array([pydec.Decimal(int(c) * 10**6) / 10**4
+                         for c in cents], pa.decimal128(16, 4)),
+        "d128": pa.array([pydec.Decimal(v) / 10**6 for v in big],
+                         pa.decimal128(38, 6)),
+        "d32n": pa.array([None if m else pydec.Decimal(int(c)) / 100
+                          for m, c in zip(mask, cents)],
+                         pa.decimal128(7, 2)),
+    })
+    for compression in ("NONE", "SNAPPY"):
+        for use_dict in (False, True):
+            raw = write(t, compression=compression,
+                        use_dictionary=use_dict, row_group_size=1500)
+            assert_tables_match(device_scan.scan_table(raw),
+                                decode.read_table(raw))
+
+
+def test_int_phys_decimals_device():
+    # DECIMAL carried on INT32/INT64 physical types (Spark writers)
+    n = 2000
+    rng = np.random.default_rng(29)
+    import decimal as pydec
+    t = pa.table({
+        "p32": pa.array([pydec.Decimal(int(v)) / 100
+                         for v in rng.integers(-10**7, 10**7, n)],
+                        pa.decimal128(9, 2)),
+        "p64": pa.array([pydec.Decimal(int(v)) / 10**4
+                         for v in rng.integers(-10**13, 10**13, n)],
+                        pa.decimal128(18, 4)),
+    })
+    import pyarrow.parquet as _pq
+    import io as _io
+    buf = _io.BytesIO()
+    _pq.write_table(t, buf, use_dictionary=False,
+                    store_decimal_as_integer=True)
+    raw = buf.getvalue()
+    assert_tables_match(device_scan.scan_table(raw),
+                        decode.read_table(raw))
+
+
+def test_non_decimal_flba_falls_back():
+    # fixed_size_binary without a DECIMAL annotation (UUIDs/hashes) must
+    # ride the host decoder, not the decimal limb path
+    n = 300
+    vals = [bytes([i % 251] * 8) for i in range(n)]
+    t = pa.table({"u": pa.array(vals, pa.binary(8)),
+                  "x": pa.array(np.arange(n, dtype=np.int64))})
+    raw = write(t, use_dictionary=False)
+    assert_tables_match(device_scan.scan_table(raw), decode.read_table(raw))
