@@ -142,11 +142,16 @@ def _chained_loop(body, data):
 
 
 def time_diff(body, data, lo: int, hi: int, repeats: int = 2) -> float:
-    """Steady-state seconds/iteration by trip-count differencing."""
+    """Steady-state seconds/iteration by trip-count differencing.
+
+    A repeat whose delta is non-positive (t_hi <= t_lo: pure timing noise,
+    e.g. a tunnel stall during the lo run) is discarded and retried rather
+    than clamped — clamping to 1e-9 s would report an absurd ~1e9× GB/s."""
     run = _chained_loop(body, data)
     np.asarray(run(data, lo))            # compile + warm
     best = None
-    for _ in range(repeats):
+    good = 0
+    for _ in range(repeats + 3):         # up to 3 extra retries for noise
         t0 = time.perf_counter()
         np.asarray(run(data, lo))
         t_lo = time.perf_counter() - t0
@@ -154,8 +159,17 @@ def time_diff(body, data, lo: int, hi: int, repeats: int = 2) -> float:
         np.asarray(run(data, hi))
         t_hi = time.perf_counter() - t0
         per = (t_hi - t_lo) / (hi - lo)
+        if per <= 0:
+            continue
+        good += 1
         best = per if best is None else min(best, per)
-    return max(best, 1e-9)
+        if good >= repeats:
+            break
+    if best is None:
+        raise RuntimeError(
+            f"time_diff: every repeat non-positive (last: t_lo={t_lo:.3f}s "
+            f"t_hi={t_hi:.3f}s) — timing unusable, not clamping")
+    return best
 
 
 def bench_fixed(name: str, table: Table, lo: int, hi: int, results: list):

@@ -265,7 +265,9 @@ def q65(tables: dict[str, Table], frac: float = 0.9) -> Table:
     cols = SS_COLS + ITEM_COLS
     rev = groupby_aggregate(j, [cols.index("i_brand_id")],
                             [(cols.index("ss_ext_sales_price"), "sum")])
-    threshold = float(np.asarray(mean(rev[1]))) * frac
+    # device scalar — a host pull here would both cost a sync and break
+    # whole-query tracing (models/compiled.py); the comparison broadcasts
+    threshold = mean(rev[1]) * frac
     return sort_table(
         apply_boolean_mask(rev, _range_mask(rev[1], hi=threshold,
                                             hi_strict=True)), [0])

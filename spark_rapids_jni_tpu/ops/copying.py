@@ -72,12 +72,14 @@ def _slice_column(col: Column, start: int, stop: int) -> Column:
                       [_slice_column(ch, start, stop) for ch in col.children])
     if col.dtype.id == T.TypeId.LIST:
         offs = col.offsets[start:stop + 1]
-        c0, c1 = int(offs[0]), int(offs[-1])
+        from ..utils import syncs
+        c0, c1 = syncs.scalar(offs[0]), syncs.scalar(offs[-1])
         return Column(col.dtype, col.data, offs - offs[0], v,
                       [_slice_column(col.children[0], c0, c1)])
     if col.dtype.is_variable_width:
         offs = col.offsets[start:stop + 1]
-        c0, c1 = int(offs[0]), int(offs[-1])
+        from ..utils import syncs
+        c0, c1 = syncs.scalar(offs[0]), syncs.scalar(offs[-1])
         return Column(col.dtype, col.data[c0:c1], offs - offs[0], v)
     return Column(col.dtype, col.data[start:stop], validity=v)
 

@@ -25,7 +25,8 @@ def _segment_gather(offs: jnp.ndarray, idx: jnp.ndarray):
     """Element indices + new offsets for gathering variable-width segments."""
     lens = (offs[1:] - offs[:-1])[idx]
     new_offs = jnp.concatenate([jnp.zeros(1, lens.dtype), jnp.cumsum(lens)])
-    total = int(new_offs[-1])
+    from ..utils import syncs
+    total = syncs.scalar(new_offs[-1])   # size resolution (capture/replay)
     starts = offs[:-1][idx]
     elem_ids = jnp.arange(total, dtype=jnp.int64)
     row_of = jnp.searchsorted(new_offs.astype(jnp.int64), elem_ids,
@@ -116,6 +117,32 @@ def isin(col: Column, values) -> jnp.ndarray:
                 m = m | eq
     elif col.dtype.is_nested or col.dtype.id == T.TypeId.DECIMAL128:
         raise NotImplementedError(f"isin on {col.dtype.id.name}")
+    elif col.dtype.id == T.TypeId.FLOAT64:
+        # Membership on the canonicalized bit lanes, not decoded values: on
+        # TPU ``from_bits`` carries ~48 mantissa bits, so two distinct
+        # doubles can decode equal and match spuriously.  Probes are
+        # bit-converted on host (exact) with the same canonicalization as
+        # ``group_key_lanes`` (-0.0 == 0.0, all NaNs one value — Spark
+        # equality, under which NaN IN (NaN) is true).
+        from ..utils.f64bits import equality_key_u64, np_equality_key_u64
+        probes = []
+        for v in values:
+            if v is None:
+                continue
+            try:
+                fv = np.float64(v)
+            except (OverflowError, ValueError, TypeError):
+                continue
+            if np.isnan(fv) or fv == v or isinstance(v, float):
+                probes.append(fv)
+        if not probes:
+            m = jnp.zeros(col.num_rows, bool)
+        else:
+            pb = np_equality_key_u64(np.asarray(probes, np.float64))
+            key = equality_key_u64(col.data)
+            vals = jnp.sort(jnp.asarray(np.unique(pb)))
+            pos = jnp.clip(jnp.searchsorted(vals, key), 0, vals.shape[0] - 1)
+            m = vals[pos] == key
     else:
         # keep only probes that survive an EXACT round trip into the
         # column's storage dtype — a lossy cast (3.5 → 3 into int32, or an

@@ -83,6 +83,35 @@ def _agg_segment(data, valid, seg_ids, agg, num_segments, storage_kind):
     raise ValueError(f"unknown aggregation {agg!r} (supported: {_AGGS})")
 
 
+def _f64_select_pos(col, seg_ids, num_segments, agg):
+    """Row position per segment whose FLOAT64 bits the selection aggregate
+    returns (see the FLOAT64 branch in :func:`groupby_aggregate`)."""
+    n = col.data.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    valid = col.validity
+    if agg == "first":
+        vpos = pos if valid is None else jnp.where(valid, pos, n)
+        return jax.ops.segment_min(vpos, seg_ids, num_segments)
+    if agg == "last":
+        vpos = pos if valid is None else jnp.where(valid, pos, -1)
+        return jax.ops.segment_max(vpos, seg_ids, num_segments)
+    from .sort import f64_sort_key_lanes
+    lo_k, hi_k = f64_sort_key_lanes(col)
+    key = (hi_k.astype(jnp.uint64) << 32) | lo_k.astype(jnp.uint64)
+    if agg == "max":
+        key = ~key
+    if valid is not None:
+        key = jnp.where(valid, key, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+    best = jax.ops.segment_min(key, seg_ids, num_segments)
+    hit = key == best[seg_ids]
+    if valid is not None:
+        # a valid extreme can tie the invalid sentinel (valid -inf under
+        # max, all-NaN under min) — never gather a null row's stale bits
+        hit = hit & valid
+    vpos = jnp.where(hit, pos, n)
+    return jax.ops.segment_min(vpos, seg_ids, num_segments)
+
+
 def _var_segment(x, valid, seg_ids, num_segments, cnt, std: bool):
     """Sample variance/stddev (ddof=1, Spark var_samp/stddev_samp), two-pass:
     segment mean first, then squared deviations — the one-pass
@@ -192,6 +221,23 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
                     f"decimal128 groupby supports sum/count only, got {agg!r}")
             from . import decimal128 as d128
             out_cols.append(d128.segmented_sum(col, seg_ids, num_segments))
+            continue
+        if (col.dtype.id == T.TypeId.FLOAT64
+                and agg in ("min", "max", "first", "last")):
+            # Selection aggregates return an EXISTING row's value, whose
+            # exact bits are already resident as u32 pairs — gather them
+            # positionally instead of round-tripping through from_bits/
+            # to_bits (which perturbs bits on TPU: ~48-mantissa-bit
+            # emulation, f32-like exponent window).  min/max select via
+            # the monotone bits→uint sort key (NaN largest — Spark order).
+            p = _f64_select_pos(col, seg_ids, num_segments, agg)
+            bits = col.data[jnp.clip(p, 0, max(n - 1, 0))]
+            if col.validity is not None:
+                cnt = _agg_segment(col.data[:, 0], col.validity, seg_ids,
+                                   "count", num_segments, "i")
+                out_cols.append(Column(col.dtype, bits, validity=cnt > 0))
+            else:
+                out_cols.append(Column(col.dtype, bits))
             continue
         data = col.values()   # FLOAT64 bit pairs decode to f64 values
         if col.dtype.is_decimal and agg in ("mean", "var", "std"):

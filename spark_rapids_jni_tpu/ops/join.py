@@ -27,7 +27,15 @@ from .filter import gather
 
 def _key_with_nulls_last(col: Column):
     """Key lane where null rows are moved past any real key (never match)."""
-    data = col.values()   # FLOAT64 bit pairs decode to sortable f64 values
+    if col.dtype.id.name == "FLOAT64":
+        # Compare the stored bit pattern, not decoded values: on TPU
+        # ``from_bits`` carries ~48 mantissa bits, so two distinct doubles
+        # can decode equal.  The canonicalized (-0.0 == 0.0, all NaNs one
+        # value — Spark join equality) monotone bits→uint map keeps both
+        # order and equality exact with zero f64 arithmetic.
+        from ..utils.f64bits import ordered_key_u64
+        return ordered_key_u64(col.data), col.validity
+    data = col.values()
     if col.validity is None:
         return data, None
     return data, col.validity

@@ -67,15 +67,13 @@ def f64_sort_key_lanes(col, descending: bool = False) -> list[jnp.ndarray]:
     All NaNs (either sign, any payload) map to the single maximum key —
     Spark's NaN-largest total order — before the optional descending
     inversion, so NaN sorts last ascending and first descending."""
-    from ..utils.f64bits import is_nan_bits
+    from ..utils.f64bits import is_nan_bits, monotone_lanes
     lo = col.data[:, 0]
     hi = col.data[:, 1]
     nan = is_nan_bits(lo, hi)
-    neg = (hi >> jnp.uint32(31)) != 0
-    hi_k = jnp.where(nan, jnp.uint32(0xFFFFFFFF),
-                     jnp.where(neg, ~hi, hi ^ jnp.uint32(0x80000000)))
-    lo_k = jnp.where(nan, jnp.uint32(0xFFFFFFFF),
-                     jnp.where(neg, ~lo, lo))
+    lo_m, hi_m = monotone_lanes(lo, hi)   # shared map: joins stay in lockstep
+    hi_k = jnp.where(nan, jnp.uint32(0xFFFFFFFF), hi_m)
+    lo_k = jnp.where(nan, jnp.uint32(0xFFFFFFFF), lo_m)
     if descending:
         hi_k, lo_k = ~hi_k, ~lo_k
     return [lo_k, hi_k]

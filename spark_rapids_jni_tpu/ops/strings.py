@@ -264,7 +264,8 @@ def substring(col: Column, start: int, length: Optional[int] = None) -> Column:
         new_lens = jnp.minimum(new_lens, length)
     new_offs = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), jnp.cumsum(new_lens, dtype=jnp.int32)])
-    total = int(new_offs[-1])                  # scalar sync (chars total)
+    from ..utils import syncs
+    total = syncs.scalar(new_offs[-1])         # scalar sync (chars total)
     if total == 0:
         return Column(T.string, jnp.zeros(0, jnp.uint8), new_offs, col.validity)
     row_of = _segment_of(new_offs, total)
@@ -285,7 +286,8 @@ def concat(a: Column, b: Column) -> Column:
     new_lens = la + lb
     new_offs = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), jnp.cumsum(new_lens, dtype=jnp.int32)])
-    total = int(new_offs[-1])                  # scalar sync (chars total)
+    from ..utils import syncs
+    total = syncs.scalar(new_offs[-1])         # scalar sync (chars total)
     if total == 0:
         return Column(T.string, jnp.zeros(0, jnp.uint8), new_offs, valid)
     row_of = _segment_of(new_offs, total)
@@ -637,7 +639,8 @@ def _matrix_to_strings(mat: jnp.ndarray, starts: jnp.ndarray,
     lens = jnp.where(validity, lens, 0) if validity is not None else lens
     new_offs = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
-    total = int(new_offs[-1])                 # scalar sync (chars total)
+    from ..utils import syncs
+    total = syncs.scalar(new_offs[-1])        # scalar sync (chars total)
     if total == 0:
         return Column(T.string, jnp.zeros(0, jnp.uint8), new_offs, validity)
     row_of = _segment_of(new_offs, total)

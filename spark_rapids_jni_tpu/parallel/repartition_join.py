@@ -18,12 +18,17 @@ TPU-first design notes:
 * all shapes static: fixed per-destination bucket capacity with drop
   accounting (callers size with headroom, same two-phase discipline as the
   reference's ≤2GB batches);
-* the local join is searchsorted over the received build side — the TPU
-  formulation of a hash probe (no pointer chasing);
-* build keys must be globally unique (PK side).  Hash partitioning
-  co-locates every copy of a key, so the probe resolves each fact row to
-  at most one build row — exactly cudf's `inner_join` contract for the
-  plugin's PK-FK joins.
+* the local join is a segment-run probe over the received build side — the
+  TPU formulation of a hash probe (no pointer chasing).  Duplicate build
+  keys are first-class (cudf ``inner_join`` semantics): equal-key build
+  rows form a run; each fact row's value is aggregated once per run
+  (searchsorted + segment-add), then distributed to every build row of the
+  run — each (fact, build) pair contributes exactly once without ever
+  materializing the expanded pairs;
+* capacities are sized automatically by a count pass
+  (:func:`repartition_join_agg_auto`) — the same two-phase discipline as the
+  reference's batch sizing (``row_conversion.cu:1460-1539``) — so bucket
+  overflow is structurally impossible on the auto path.
 
 Reference parity: the reference emits shuffle-ready blobs and hands them to
 Spark's shuffle (SURVEY §5.8); here the shuffle AND the join execute on
@@ -102,21 +107,37 @@ def _local_join_agg(spec: JoinAggSpec, axis_name, num_partitions,
     bkey_s = bkey[order]
     blive_s = blive[order]
     bgroup_s = bdatas[spec.build_group_idx][order]
+    nb = bkey_s.shape[0]
+
+    # equal-key runs over the sorted build side (duplicate keys are
+    # first-class: every build row of a fact row's run matches it)
+    head = jnp.concatenate([jnp.ones(1, jnp.int32),
+                            (bkey_s[1:] != bkey_s[:-1]).astype(jnp.int32)])
+    run_id = jnp.cumsum(head) - 1                       # int32 [nb]
 
     fkey = fdatas[spec.fact_key_idx]
     flive = fmask & fvalidm[:, spec.fact_key_idx]
-    pos = jnp.clip(jnp.searchsorted(bkey_s, fkey), 0, bkey_s.shape[0] - 1)
+    pos = jnp.clip(jnp.searchsorted(bkey_s, fkey), 0, max(nb - 1, 0))
     hit = flive & (bkey_s[pos] == fkey) & blive_s[pos]
 
-    # sentinel group absorbs misses via mode="drop"
-    g = jnp.where(hit, bgroup_s[pos].astype(jnp.int32),
-                  jnp.int32(spec.num_groups))
+    # phase 1: aggregate fact rows once per RUN (not per build row) —
+    # sentinel run nb absorbs misses via mode="drop"
+    rf = jnp.where(hit, run_id[pos], jnp.int32(nb))
     val = fdatas[spec.fact_value_idx].astype(jnp.int64)
     fval_ok = fvalidm[:, spec.fact_value_idx]
-    sums = jnp.zeros(spec.num_groups, jnp.int64).at[g].add(
+    run_sums = jnp.zeros(nb, jnp.int64).at[rf].add(
         jnp.where(hit & fval_ok, val, 0), mode="drop")
-    cnts = jnp.zeros(spec.num_groups, jnp.int32).at[g].add(
+    run_cnts = jnp.zeros(nb, jnp.int32).at[rf].add(
         hit.astype(jnp.int32), mode="drop")
+
+    # phase 2: distribute each run's fact aggregate to every live build row
+    # of the run — exactly one contribution per (fact, build) pair
+    g = jnp.where(blive_s, bgroup_s.astype(jnp.int32),
+                  jnp.int32(spec.num_groups))
+    sums = jnp.zeros(spec.num_groups, jnp.int64).at[g].add(
+        jnp.where(blive_s, run_sums[run_id], 0), mode="drop")
+    cnts = jnp.zeros(spec.num_groups, jnp.int32).at[g].add(
+        jnp.where(blive_s, run_cnts[run_id], 0), mode="drop")
     return (jax.lax.psum(sums, axis_name), jax.lax.psum(cnts, axis_name),
             jax.lax.psum(fdrop + bdrop, axis_name))
 
@@ -145,13 +166,78 @@ def repartition_join_agg(mesh: jax.sharding.Mesh, spec: JoinAggSpec,
                          axis_name: str = "data"):
     """SELECT g, SUM(fact.value), COUNT(*) FROM fact JOIN build USING (key)
     GROUP BY build.group — both sides sharded, repartitioned over ICI.
+    Duplicate build keys join every matching fact row (cudf ``inner_join``
+    semantics).
 
     ``*_datas`` are global column arrays (row counts divisible by the mesh
     size), ``*_valid`` the [n, ncols] validity matrices.  Returns
     replicated (sums int64 [num_groups], counts int32 [num_groups],
-    dropped int32) — ``dropped > 0`` means a bucket capacity overflowed and
-    the caller must retry with more headroom (two-phase sizing, like the
-    reference's batch-size pass).
+    dropped int32).  With explicit capacities ``dropped > 0`` reports
+    overflow; use :func:`repartition_join_agg_auto` to size capacities by a
+    count pass so overflow cannot happen.
     """
     fn = _compiled_join_agg(mesh, spec, axis_name)
     return fn(tuple(fact_datas), fact_valid, tuple(build_datas), build_valid)
+
+
+def _local_bucket_need(axis_name, num_partitions, fact_key, build_key):
+    """Per-chip count pass: the largest per-destination bucket each side
+    needs anywhere on the mesh (replicated scalars)."""
+    needs = []
+    for key in (fact_key, build_key):
+        part = hash_partition(murmur3_32(key), num_partitions)
+        counts = jnp.zeros(num_partitions, jnp.int32).at[part].add(
+            1, mode="drop")
+        needs.append(jax.lax.pmax(jnp.max(counts), axis_name))
+    return needs[0], needs[1]
+
+
+@lru_cache(maxsize=16)
+def _compiled_bucket_need(mesh, axis_name):
+    P = jax.sharding.PartitionSpec
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    num_partitions = int(np.prod([mesh.shape[a] for a in axes]))
+    fn = jax.shard_map(
+        partial(_local_bucket_need, axis_name, num_partitions),
+        mesh=mesh, in_specs=(P(axis_name), P(axis_name)),
+        out_specs=(P(), P()))
+    return jax.jit(fn)
+
+
+def _bucket_capacity(need: int) -> int:
+    """Round a measured bucket need up to a shared compile-key bucket
+    (≤ ~12.5% growth), multiple of 8."""
+    need = max(int(need), 8)
+    p = 8
+    while p < need:
+        p <<= 1
+    step = max(8, p // 8)
+    return -(-need // step) * step
+
+
+def repartition_join_agg_auto(mesh: jax.sharding.Mesh,
+                              fact_schema, build_schema,
+                              fact_key_idx: int, build_key_idx: int,
+                              build_group_idx: int, fact_value_idx: int,
+                              num_groups: int,
+                              fact_datas: Sequence[jnp.ndarray],
+                              fact_valid: jnp.ndarray,
+                              build_datas: Sequence[jnp.ndarray],
+                              build_valid: jnp.ndarray,
+                              axis_name: str = "data"):
+    """:func:`repartition_join_agg` with automatic two-phase capacity
+    sizing: a count pass measures the true per-destination bucket maxima
+    (one tiny sync), capacities are bucketed for compile-cache reuse, and
+    the sized program runs with overflow structurally impossible."""
+    need_fn = _compiled_bucket_need(mesh, axis_name)
+    nf, nb = need_fn(fact_datas[fact_key_idx], build_datas[build_key_idx])
+    needs = np.asarray(jnp.stack([nf, nb]))      # ONE host sync, two scalars
+    spec = JoinAggSpec(
+        fact_schema=tuple(fact_schema), build_schema=tuple(build_schema),
+        fact_key_idx=fact_key_idx, build_key_idx=build_key_idx,
+        build_group_idx=build_group_idx, fact_value_idx=fact_value_idx,
+        num_groups=num_groups,
+        fact_capacity=_bucket_capacity(needs[0]),
+        build_capacity=_bucket_capacity(needs[1]))
+    return repartition_join_agg(mesh, spec, fact_datas, fact_valid,
+                                build_datas, build_valid, axis_name)

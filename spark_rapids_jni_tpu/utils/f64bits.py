@@ -87,6 +87,42 @@ def group_key_lanes(bits: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     return lo, hi
 
 
+def monotone_lanes(lo: jnp.ndarray, hi: jnp.ndarray):
+    """The classic order-preserving bits→uint map on (lo, hi) u32 lanes:
+    negatives inverted, positives sign-flipped.  Callers decide NaN
+    handling BEFORE this map.  Single source for sort keys, join keys and
+    any other ordered-comparison consumer (they must stay in lockstep)."""
+    neg = (hi >> jnp.uint32(31)) != 0
+    hi_k = jnp.where(neg, ~hi, hi ^ jnp.uint32(0x80000000))
+    lo_k = jnp.where(neg, ~lo, lo)
+    return lo_k, hi_k
+
+
+def ordered_key_u64(bits: jnp.ndarray) -> jnp.ndarray:
+    """One u64 key per row that is exact for BOTH Spark equality
+    (-0.0 == 0.0, all NaNs one value — ``group_key_lanes``) and numeric
+    order (monotone map) — the join-key form."""
+    lo, hi = group_key_lanes(bits)
+    lo_k, hi_k = monotone_lanes(lo, hi)
+    return (hi_k.astype(jnp.uint64) << 32) | lo_k.astype(jnp.uint64)
+
+
+def equality_key_u64(bits: jnp.ndarray) -> jnp.ndarray:
+    """Canonicalized u64 bit key: equality-only form (membership tests)."""
+    lo, hi = group_key_lanes(bits)
+    return (hi.astype(jnp.uint64) << 32) | lo.astype(jnp.uint64)
+
+
+def np_equality_key_u64(arr: np.ndarray) -> np.ndarray:
+    """Host-side exact probe keys under the same canonicalization as
+    :func:`equality_key_u64` (-0.0 → +0.0, all NaNs → one quiet NaN)."""
+    a = np.ascontiguousarray(arr, dtype=np.float64)
+    bits = a.view(np.uint64)
+    bits = np.where(np.isnan(a), np.uint64(0x7FF8000000000000), bits)
+    bits = np.where(bits == np.uint64(1) << 63, np.uint64(0), bits)
+    return bits
+
+
 def _pow2(h: jnp.ndarray) -> jnp.ndarray:
     """Exact 2.0**h for int32 h in [-537, 537] (power-of-two products are
     exact scalings in the TPU's f64 emulation)."""

@@ -65,7 +65,14 @@ def seed(device_arr, host_arr: np.ndarray) -> None:
 
 
 def peek(device_arr):
-    """The cached host mirror, or None — never triggers a transfer."""
+    """The cached host mirror, or None — never triggers a transfer.
+
+    Misses deliberately under syncs capture/replay: a mirror hit would let
+    the capture run skip a size-resolution site that the replay trace (on
+    fresh tracers) cannot skip, misaligning the recorded tape."""
+    from . import syncs
+    if syncs.mode() != "normal":
+        return None
     return _HOST.get((device_arr,))
 
 

@@ -19,17 +19,78 @@ rows).  Two countermeasures live here:
 
 from __future__ import annotations
 
+import contextlib
 # weakref handled by hostcache.WeakIdMemo
 from typing import Any
 
 _count = 0
 
+# --- capture/replay: compile a whole multi-op plan into ONE jit program ----
+#
+# Every dynamic size in the op library (join match totals, group counts,
+# string widths, compaction counts) resolves through :func:`scalar`.  A
+# *capture* run executes the plan eagerly and records the resolved sizes in
+# order; a *replay* run pops them instead of syncing — so the same plan
+# code traces under ``jax.jit`` with every shape static (the device value
+# arriving at ``scalar`` is a tracer and is simply not synced).  Both modes
+# disable the weak memos so capture and replay visit the SAME sequence of
+# resolution sites (a memo hit in one mode but not the other would
+# misalign the recorded sizes).  See ``models/compiled.py``.
+
+_mode = "normal"            # "normal" | "capture" | "replay"
+_tape: list[int] = []
+_tape_pos = 0
+
+
+def mode() -> str:
+    return _mode
+
+
+@contextlib.contextmanager
+def capture(tape: list[int]):
+    """Eager run recording every resolved size into ``tape`` (in order)."""
+    global _mode, _tape
+    if _mode != "normal":
+        raise RuntimeError(f"cannot capture while in {_mode} mode")
+    _mode, _tape = "capture", tape
+    try:
+        yield tape
+    finally:
+        _mode, _tape = "normal", []
+
+
+@contextlib.contextmanager
+def replay(tape: list[int]):
+    """Traced run resolving sizes from ``tape`` instead of device syncs."""
+    global _mode, _tape, _tape_pos
+    if _mode != "normal":
+        raise RuntimeError(f"cannot replay while in {_mode} mode")
+    _mode, _tape, _tape_pos = "replay", list(tape), 0
+    try:
+        yield
+        if _tape_pos != len(_tape):
+            raise RuntimeError(
+                f"replay consumed {_tape_pos} of {len(_tape)} recorded "
+                "sizes — plan diverged from the capture run")
+    finally:
+        _mode, _tape, _tape_pos = "normal", [], 0
+
 
 def scalar(x) -> int:
     """int(x) with sync accounting — use for every intentional D2H scalar."""
-    global _count
+    global _count, _tape_pos
+    if _mode == "replay":
+        if _tape_pos >= len(_tape):
+            raise RuntimeError(
+                "replay tape exhausted — plan diverged from the capture run")
+        v = _tape[_tape_pos]
+        _tape_pos += 1
+        return v
     _count += 1
-    return int(x)
+    v = int(x)
+    if _mode == "capture":
+        _tape.append(v)
+    return v
 
 
 def sync_count() -> int:
@@ -51,10 +112,15 @@ _MEMOS: dict[str, WeakIdMemo] = {}
 
 
 def memo_get(tag: str, arrays) -> Any:
-    """Cached value for (tag, arrays) — None on miss or if any array died."""
+    """Cached value for (tag, arrays) — None on miss or if any array died.
+    Disabled under capture/replay (see the mode note above)."""
+    if _mode != "normal":
+        return None
     memo = _MEMOS.get(tag)
     return None if memo is None else memo.get(arrays)
 
 
 def memo_put(tag: str, arrays, value) -> None:
+    if _mode != "normal":
+        return
     _MEMOS.setdefault(tag, WeakIdMemo()).put(arrays, value)

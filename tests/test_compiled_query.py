@@ -1,0 +1,52 @@
+"""Whole-query compilation (models/compiled.py): every TPC-DS subset query
+must trace into ONE jitted program under syncs capture/replay and produce
+exactly the eager result — the per-query single-dispatch contract behind
+the SF1 wall-time work (VERDICT r3 next-step #3)."""
+
+import numpy as np
+import pytest
+
+from benchmarks import tpcds_data
+from spark_rapids_jni_tpu.models import tpcds
+from spark_rapids_jni_tpu.models.compiled import compile_query
+from spark_rapids_jni_tpu.utils import syncs
+
+
+@pytest.fixture(scope="module")
+def tables():
+    files = tpcds_data.generate(n_sales=20_000, n_items=300, seed=11)
+    return tpcds.load_tables(files)
+
+
+def _tables_equal(a, b):
+    assert a.num_columns == b.num_columns
+    assert a.num_rows == b.num_rows
+    for i in range(a.num_columns):
+        ca, cb = a[i], b[i]
+        assert ca.dtype.id == cb.dtype.id
+        if ca.dtype.id.name == "STRING":
+            assert ca.to_pylist() == cb.to_pylist()
+        else:
+            np.testing.assert_array_equal(np.asarray(ca.to_numpy()),
+                                          np.asarray(cb.to_numpy()))
+
+
+@pytest.mark.parametrize("qname", sorted(tpcds.QUERIES))
+def test_compiled_matches_eager(tables, qname):
+    qfn = tpcds.QUERIES[qname]
+    cq = compile_query(qfn, tables)
+    out = cq.run(tables)
+    _tables_equal(out, cq.expected)
+    # steady state: re-execution is ONE dispatch, ZERO host syncs
+    before = syncs.sync_count()
+    out2 = cq.run(tables)
+    assert syncs.sync_count() == before
+    _tables_equal(out2, cq.expected)
+
+
+def test_replay_detects_divergence(tables):
+    cq = compile_query(tpcds.QUERIES["q3"], tables)
+    # a tape for a different plan must not silently misresolve
+    with pytest.raises(Exception):
+        with syncs.replay(list(cq.tape[:1])):
+            tpcds.QUERIES["q3"](tables)

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 import spark_rapids_jni_tpu as sr
 from spark_rapids_jni_tpu.parallel import make_mesh
 from spark_rapids_jni_tpu.parallel.repartition_join import (
-    JoinAggSpec, repartition_join_agg)
+    JoinAggSpec, repartition_join_agg, repartition_join_agg_auto)
 
 N_DEV = 8
 
@@ -118,6 +118,76 @@ def test_skewed_keys_all_land(mesh):
     assert dropped == 0
     np.testing.assert_array_equal(sums, want_s)
     np.testing.assert_array_equal(cnts, want_c)
+
+
+def test_duplicate_build_keys_expand_matches(mesh):
+    # cudf inner_join semantics: each fact row joins EVERY matching build
+    # row.  Build side: ~3 rows per key on average, different categories.
+    rng = np.random.default_rng(11)
+    n_fact, n_item, n_cat = 2048, 384, 6
+    base_keys = np.arange(500, 500 + n_item // 3, dtype=np.int64)
+    item_sk = rng.choice(base_keys, n_item).astype(np.int64)
+    item_cat = rng.integers(0, n_cat, n_item).astype(np.int32)
+    fact_sk = np.where(rng.random(n_fact) < 0.8,
+                       base_keys[rng.integers(0, base_keys.shape[0], n_fact)],
+                       rng.integers(90_000, 99_000, n_fact)).astype(np.int64)
+    fact_qty = rng.integers(1, 50, n_fact).astype(np.int64)
+    fv = np.ones((n_fact, 2), bool)
+    iv = np.ones((n_item, 2), bool)
+    sums, cnts, dropped = _run(mesh, item_sk, item_cat, fact_sk, fact_qty,
+                               fv, iv, n_cat,
+                               fact_capacity=n_fact, build_capacity=n_item)
+    want_s, want_c = _oracle(item_sk, item_cat, fact_sk, fact_qty, fv, iv,
+                             n_cat)
+    assert dropped == 0
+    np.testing.assert_array_equal(sums, want_s)
+    np.testing.assert_array_equal(cnts, want_c)
+
+
+def test_duplicate_keys_with_nulls(mesh):
+    rng = np.random.default_rng(13)
+    n_fact, n_item, n_cat = 1024, 256, 5
+    base = np.arange(10, 110, dtype=np.int64)
+    item_sk = rng.choice(base, n_item).astype(np.int64)
+    item_cat = rng.integers(0, n_cat, n_item).astype(np.int32)
+    fact_sk = base[rng.integers(0, base.shape[0], n_fact)].astype(np.int64)
+    fact_qty = rng.integers(1, 9, n_fact).astype(np.int64)
+    fv = np.ones((n_fact, 2), bool)
+    iv = np.ones((n_item, 2), bool)
+    fv[:, 0] = rng.random(n_fact) < 0.9
+    iv[:, 0] = rng.random(n_item) < 0.9
+    sums, cnts, dropped = _run(mesh, item_sk, item_cat, fact_sk, fact_qty,
+                               fv, iv, n_cat,
+                               fact_capacity=n_fact, build_capacity=n_item)
+    want_s, want_c = _oracle(item_sk, item_cat, fact_sk, fact_qty, fv, iv,
+                             n_cat)
+    assert dropped == 0
+    np.testing.assert_array_equal(sums, want_s)
+    np.testing.assert_array_equal(cnts, want_c)
+
+
+def test_auto_capacity_never_drops(mesh):
+    # the shape that overflowed with fact_capacity=2 sizes itself now —
+    # including under the skew that concentrates 60% on one partition
+    rng = np.random.default_rng(9)
+    n_fact, n_item, n_cat = 2048, 64, 5
+    item_sk = np.arange(100, 100 + n_item, dtype=np.int64)
+    item_cat = rng.integers(0, n_cat, n_item).astype(np.int32)
+    fact_sk = np.where(rng.random(n_fact) < 0.6, item_sk[7],
+                       item_sk[rng.integers(0, n_item, n_fact)]).astype(np.int64)
+    fact_qty = rng.integers(1, 10, n_fact).astype(np.int64)
+    fv = np.ones((n_fact, 2), bool)
+    iv = np.ones((n_item, 2), bool)
+    sums, cnts, dropped = repartition_join_agg_auto(
+        mesh, (sr.int64, sr.int64), (sr.int64, sr.int32),
+        0, 0, 1, 1, n_cat,
+        (jnp.asarray(fact_sk), jnp.asarray(fact_qty)), jnp.asarray(fv),
+        (jnp.asarray(item_sk), jnp.asarray(item_cat)), jnp.asarray(iv))
+    want_s, want_c = _oracle(item_sk, item_cat, fact_sk, fact_qty, fv, iv,
+                             n_cat)
+    assert int(np.asarray(dropped)) == 0
+    np.testing.assert_array_equal(np.asarray(sums), want_s)
+    np.testing.assert_array_equal(np.asarray(cnts), want_c)
 
 
 def test_max_value_key_still_joins(mesh):

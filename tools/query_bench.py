@@ -3,19 +3,23 @@
 
 BASELINE config #3 at scale: a 10M-row store_sales fact (20K items, 50
 stores, 3 years of dates) generated as snappy parquet, decoded through the
-scan path, then a representative query slice timed twice:
+scan path, then a representative query slice measured three ways:
 
-  run 1 (cold): jit compiles + one-time dictionary/width syncs
-  run 2 (warm): steady state — compiled programs, memoized dictionary
-                encodes and string widths (``utils/syncs.py``)
+  cold     — eager capture run: jit compiles + the plan's size-resolution
+             syncs (``models/compiled.py`` records the tape here)
+  warm     — the compiled ONE-PROGRAM form: wall time of a single dispatch
+             + result materialization through the tunnel (syncs counted;
+             steady state is 0 plan syncs — only the result pull remains)
+  steady   — trip-count-differenced in-jit time of the compiled program
+             (same methodology as bench.py): pure device time per query,
+             the number comparable against local pandas wall time, since
+             the ~65-110 ms tunnel RTT is a deployment artifact, not a
+             property of the engine
 
-For each run the wall time AND the number of intentional host scalar syncs
-(the ``syncs.scalar`` funnel: group counts, filter counts, string widths,
-dictionary sizes) are recorded — the VERDICT r2 "sync-count-per-query"
-figure.  On the tunneled chip each sync costs ~65-110 ms, so warm counts
-approximate the dispatch-bound floor of a plan.
+The JAX persistent compilation cache is enabled so a second process's cold
+run reuses every compiled program (VERDICT r3 next-step #3).
 
-Usage: python tools/query_bench.py [n_sales] [out.json]
+Usage: python tools/query_bench.py [n_sales] [out.json] [q1,q2,...]
 """
 
 import json
@@ -28,7 +32,49 @@ sys.path.insert(0, ".")
 
 import jax
 
+# persistent compile cache: cold runs in a fresh process reuse executables
+jax.config.update("jax_compilation_cache_dir", ".jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+import jax.numpy as jnp
+from jax import lax
+
 RESULTS = {"queries": {}}
+
+
+def steady_per_iter(prog, tables, lo=2, hi=6):
+    """Differenced steady-state seconds per query execution."""
+    @jax.jit
+    def run(tbls, iters):
+        def step(_, carry):
+            acc, t = carry
+            tin = lax.optimization_barrier((t, acc))[0]
+            out = prog(tin)
+            out = lax.optimization_barrier(out)
+            # probe the first NON-EMPTY leaf (a 0-row result table has
+            # size-0 columns; indexing them would fail at trace time)
+            leaves = [l for l in jax.tree_util.tree_leaves(out) if l.size]
+            probe = (lax.convert_element_type(jnp.ravel(leaves[0])[0],
+                                              jnp.int32)
+                     if leaves else jnp.int32(0))
+            return (acc + probe) % jnp.int32(65521), t
+        acc, _ = lax.fori_loop(0, iters, step, (jnp.int32(0), tbls))
+        return acc
+
+    np.asarray(run(tables, lo))          # compile + warm
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(run(tables, lo))
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        np.asarray(run(tables, hi))
+        t_hi = time.perf_counter() - t0
+        per = (t_hi - t_lo) / (hi - lo)
+        if per > 0:
+            best = per if best is None else min(best, per)
+    return best
 
 
 def main():
@@ -38,6 +84,7 @@ def main():
 
     from benchmarks import tpcds_data
     from spark_rapids_jni_tpu.models import tpcds
+    from spark_rapids_jni_tpu.models.compiled import compile_query
     from spark_rapids_jni_tpu.utils import syncs
 
     t0 = time.perf_counter()
@@ -62,23 +109,41 @@ def main():
     for name in chosen:
         fn = tpcds.QUERIES[name]
         entry = {}
-        for run in ("cold", "warm"):
+        try:
+            # cold: eager capture (compiles + size syncs, tape recorded)
             syncs.reset_sync_count()
             t0 = time.perf_counter()
-            out = fn(tables)
-            # materialize EVERY result column before stopping the clock
+            cq = compile_query(fn, tables)
+            jax.block_until_ready([c.data for c in cq.expected.columns])
+            if cq.expected.num_rows:
+                np.asarray(cq.expected[0].data[:1])
+            entry["cold_wall_s"] = round(time.perf_counter() - t0, 2)
+            entry["cold_syncs"] = syncs.reset_sync_count()
+            entry["tape_len"] = len(cq.tape)
+
+            # warm: the one-program form, wall incl. result pull
+            out = cq.run(tables)          # compile the fused program
             jax.block_until_ready([c.data for c in out.columns])
-            if out.num_rows:          # tiny real readback: block_until_ready
-                np.asarray(out[0].data[:1])   # is a no-op on the tunnel
-            wall = time.perf_counter() - t0
-            entry[f"{run}_wall_s"] = round(wall, 2)
-            entry[f"{run}_syncs"] = syncs.reset_sync_count()
-        entry["rows_out"] = out.num_rows
+            if out.num_rows:
+                np.asarray(out[0].data[:1])
+            syncs.reset_sync_count()
+            t0 = time.perf_counter()
+            out = cq.run(tables)
+            jax.block_until_ready([c.data for c in out.columns])
+            if out.num_rows:
+                np.asarray(out[0].data[:1])
+            entry["warm_wall_s"] = round(time.perf_counter() - t0, 3)
+            entry["warm_syncs"] = syncs.reset_sync_count()
+            entry["rows_out"] = out.num_rows
+
+            # steady: differenced in-jit device time per execution
+            per = steady_per_iter(cq._prog, tables)
+            entry["steady_ms"] = (round(per * 1e3, 1)
+                                  if per is not None else None)
+        except Exception as e:  # noqa: BLE001 — record, keep going
+            entry["error"] = repr(e)[:300]
         RESULTS["queries"][name] = entry
-        print(f"{name}: cold {entry['cold_wall_s']}s "
-              f"({entry['cold_syncs']} syncs) -> warm "
-              f"{entry['warm_wall_s']}s ({entry['warm_syncs']} syncs), "
-              f"{out.num_rows} rows", flush=True)
+        print(f"{name}: {entry}", flush=True)
         # flush after every query: a worker crash on a later (heavier)
         # query must not lose the measurements already taken
         with open(out_path, "w") as f:
