@@ -124,6 +124,10 @@ def _walk_chunk_raw(file_bytes: bytes, chunk, max_def: int, max_rep: int,
         elif enc in (D.ENC_PLAIN_DICTIONARY, D.ENC_RLE_DICTIONARY):
             if dictionary is None:
                 return None
+            if len(page_vals) == 0:
+                # zero present values / truncated page: degrade to the host
+                # decoder like every other unsupported shape
+                return None
             bw = page_vals[0]
             idx_parts.append(D.decode_rle_bitpacked_hybrid(
                 page_vals[1:], bw, n_present).astype(np.int32))
