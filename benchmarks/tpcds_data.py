@@ -95,6 +95,9 @@ def generate(n_sales: int = 100_000, n_items: int = 2000,
     n_web = max(n_sales // 3, 1)
     w_price = rng.integers(100, 300_00, n_web).astype(np.int64)
     w_qty = rng.integers(1, 100, n_web).astype(np.int32)
+    # ~3% null prices: COUNT(*) vs COUNT(col) and null-skipping SUM must
+    # actually diverge somewhere in the dataset (q_null_share family)
+    w_ext = (w_price * w_qty).astype(np.float64) / 100.0
     web_sales = pa.table({
         "ws_sold_date_sk": pa.array(
             rng.integers(1, n_dates + 1, n_web).astype(np.int32)),
@@ -102,7 +105,7 @@ def generate(n_sales: int = 100_000, n_items: int = 2000,
             rng.integers(1, n_items + 1, n_web).astype(np.int32)),
         "ws_quantity": pa.array(w_qty),
         "ws_ext_sales_price": pa.array(
-            (w_price * w_qty).astype(np.float64) / 100.0),
+            w_ext, mask=rng.random(n_web) < 0.03),
     })
 
     return {"store_sales": _parquet(store_sales), "item": _parquet(item),

@@ -25,6 +25,12 @@ if command -v javac >/dev/null 2>&1; then
 the reference jar's .class payload analog, pom.xml:450-471)."""
 PYEOF
     javac -d "$CLASSDIR" $(find java -name '*.java')
+    if command -v java >/dev/null 2>&1; then
+        echo "== java tier: JVM smoke (RowConversionSmoke) =="
+        java -Dsrjt.native.path="$(pwd)/spark_rapids_jni_tpu/native/libsrjt.so" \
+            -cp "$CLASSDIR" com.tpu.rapids.jni.RowConversionSmoke \
+            | tee ci/java_smoke.log
+    fi
 else
     echo "== java tier: no javac in environment, skipped =="
 fi

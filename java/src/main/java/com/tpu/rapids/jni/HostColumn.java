@@ -62,6 +62,34 @@ public final class HostColumn implements AutoCloseable {
     return scale;
   }
 
+  // Readback surface — the reference verifies conversions through cudf's
+  // copy-to-host accessors (RowConversionTest.java:29-59); these expose
+  // the native buffers for the same purpose.
+
+  public long getRowCount() {
+    return rows(getNativeHandle());
+  }
+
+  /** Payload byte length (fixed-width bytes, or string chars). */
+  public long getDataSize() {
+    return dataSize(getNativeHandle());
+  }
+
+  /** Address of the payload bytes (valid until close()). */
+  public long getDataAddress() {
+    return dataAddress(getNativeHandle());
+  }
+
+  /** Address of the int32 Arrow offsets, or 0 for fixed-width columns. */
+  public long getOffsetsAddress() {
+    return offsetsAddress(getNativeHandle());
+  }
+
+  /** Address of the byte-per-row validity vector, or 0 when all-valid. */
+  public long getValidityAddress() {
+    return validAddress(getNativeHandle());
+  }
+
   @Override
   public void close() {
     if (handle != 0) {
@@ -77,4 +105,14 @@ public final class HostColumn implements AutoCloseable {
       long charsAddress, long validAddress);
 
   private static native void close(long handle);
+
+  private static native long rows(long handle);
+
+  private static native long dataSize(long handle);
+
+  private static native long dataAddress(long handle);
+
+  private static native long offsetsAddress(long handle);
+
+  private static native long validAddress(long handle);
 }

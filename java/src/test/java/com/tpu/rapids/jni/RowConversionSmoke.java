@@ -1,0 +1,127 @@
+/*
+ * JVM smoke test — the reference's RowConversionTest.java:29-59 shape with
+ * no test-framework dependency (runs with a bare `java`): load libsrjt.so
+ * through NativeDepsLoader, build an 8-column host table (7 fixed-width
+ * types + 1 string column, with nulls), round-trip it through
+ * RowConversion.convertToRows/convertFromRows, and assert byte equality of
+ * every payload, offset, and validity buffer.
+ *
+ * Run (after ci/premerge.sh compiled the classes):
+ *   java -cp spark_rapids_jni_tpu/java_classes \
+ *        com.tpu.rapids.jni.RowConversionSmoke
+ */
+package com.tpu.rapids.jni;
+
+import java.lang.reflect.Field;
+import java.util.Random;
+
+public final class RowConversionSmoke {
+  private static final sun.misc.Unsafe U = unsafe();
+
+  private static sun.misc.Unsafe unsafe() {
+    try {
+      Field f = sun.misc.Unsafe.class.getDeclaredField("theUnsafe");
+      f.setAccessible(true);
+      return (sun.misc.Unsafe) f.get(null);
+    } catch (ReflectiveOperationException e) {
+      throw new RuntimeException("sun.misc.Unsafe unavailable", e);
+    }
+  }
+
+  private static long put(byte[] bytes) {
+    long addr = U.allocateMemory(Math.max(bytes.length, 1));
+    for (int i = 0; i < bytes.length; i++) {
+      U.putByte(addr + i, bytes[i]);
+    }
+    return addr;
+  }
+
+  private static void check(boolean ok, String what) {
+    if (!ok) {
+      throw new AssertionError("FAILED: " + what);
+    }
+  }
+
+  private static void checkBytes(long addr, byte[] expect, String what) {
+    for (int i = 0; i < expect.length; i++) {
+      check(U.getByte(addr + i) == expect[i], what + " byte " + i);
+    }
+  }
+
+  public static void main(String[] args) {
+    final int n = 1000;
+    Random rng = new Random(7);
+
+    // type ids follow the framework's TypeId enum (types.py): INT8=1,
+    // INT16=2, INT32=3, INT64=4, FLOAT32=9, FLOAT64=10, BOOL8=11,
+    // STRING=24 — the same marshalling RowConversion.convertFromRows takes.
+    int[] typeIds = {1, 2, 3, 4, 9, 10, 11, 24};
+    int[] scales = new int[typeIds.length];
+    int[] sizes = {1, 2, 4, 8, 4, 8, 1, 0};
+
+    byte[][] payloads = new byte[typeIds.length][];
+    byte[][] valids = new byte[typeIds.length][];
+    byte[] offsetsBytes = null;
+    HostColumn[] cols = new HostColumn[typeIds.length];
+    for (int c = 0; c < typeIds.length; c++) {
+      valids[c] = new byte[n];
+      for (int r = 0; r < n; r++) {
+        valids[c][r] = (byte) (rng.nextInt(10) == 0 ? 0 : 1);
+      }
+      if (typeIds[c] == 24) {
+        StringBuilder chars = new StringBuilder();
+        byte[] offs = new byte[(n + 1) * 4];
+        int total = 0;
+        for (int r = 0; r <= n; r++) {
+          if (r > 0 && valids[c][r - 1] != 0) {
+            String s = "s" + (r % 37);
+            chars.append(s);
+            total += s.length();
+          }
+          offs[4 * r] = (byte) total;
+          offs[4 * r + 1] = (byte) (total >> 8);
+          offs[4 * r + 2] = (byte) (total >> 16);
+          offs[4 * r + 3] = (byte) (total >> 24);
+        }
+        payloads[c] = chars.toString().getBytes();
+        offsetsBytes = offs;
+        cols[c] = HostColumn.fromStrings(
+            n, put(offs), put(payloads[c]), put(valids[c]));
+      } else {
+        payloads[c] = new byte[n * sizes[c]];
+        rng.nextBytes(payloads[c]);
+        if (typeIds[c] == 11) {                 // BOOL8: 0/1 payloads
+          for (int i = 0; i < n; i++) {
+            payloads[c][i] = (byte) (payloads[c][i] & 1);
+          }
+        }
+        cols[c] = HostColumn.fromFixedWidth(
+            typeIds[c], 0, n, put(payloads[c]), put(valids[c]));
+      }
+    }
+
+    try (HostTable table = HostTable.fromColumns(cols);
+         RowConversion.RowBatches rows = RowConversion.convertToRows(table);
+         HostTable back =
+             RowConversion.convertFromRows(rows, 0, typeIds, scales)) {
+      check(back.getRowCount() == n, "row count");
+      long[] handles = back.releaseColumns();
+      for (int c = 0; c < typeIds.length; c++) {
+        HostColumn col = HostColumn.wrap(handles[c], typeIds[c], scales[c]);
+        check(col.getRowCount() == n, "col " + c + " rows");
+        check(col.getDataSize() == payloads[c].length, "col " + c + " size");
+        checkBytes(col.getDataAddress(), payloads[c], "col " + c + " data");
+        if (typeIds[c] == 24) {
+          checkBytes(col.getOffsetsAddress(), offsetsBytes,
+              "col " + c + " offsets");
+        }
+        long va = col.getValidityAddress();
+        check(va != 0, "col " + c + " validity present");
+        checkBytes(va, valids[c], "col " + c + " validity");
+        col.close();
+      }
+    }
+    System.out.println("RowConversionSmoke OK: 8-column x " + n
+        + "-row JCUDF round trip byte-exact through libsrjt.so");
+  }
+}

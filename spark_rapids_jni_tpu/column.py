@@ -220,6 +220,74 @@ class Column:
         return [vals[i].item() if valid[i] else None for i in range(self.num_rows)]
 
 
+@jax.tree_util.register_pytree_node_class
+class LazyColumn(Column):
+    """A column whose payload materializes on first access.
+
+    This is the package's *planner-level projection pass*, done structurally
+    instead of as a rewrite: row-gathering ops (joins, filters, sorts,
+    groupbys — everything routed through ``ops.filter.gather``) return
+    ``LazyColumn``s, so a column the rest of the plan never reads is never
+    gathered — its HBM materialization AND its size-resolution sync (for
+    string columns) simply don't happen.  A 16-column join followed by a
+    3-column aggregate allocates 3 columns, not 16 — the reference gets the
+    same safety from its size-bounded batch machinery
+    (``row_conversion.cu:1460-1539``); here oversize is avoided by never
+    materializing what isn't referenced.
+
+    Forcing inside a ``jax.jit`` trace is well-defined: the deferred gather
+    simply becomes part of the traced program (better fusion than the eager
+    form).  ``tree_flatten`` forces, so jit boundaries see a plain column;
+    ``tree_unflatten`` rebuilds an eager :class:`Column`.
+    """
+
+    def __init__(self, dtype: T.DType, num_rows: int, thunk):
+        self.dtype = dtype
+        self._n = num_rows
+        self._thunk = thunk
+        self._col: Optional[Column] = None
+
+    def _force(self) -> Column:
+        if self._col is None:
+            self._col = self._thunk()
+            self._thunk = None
+        return self._col
+
+    # payload accessors (dataclass fields on Column are plain instance
+    # attributes, so these class-level properties intercept cleanly)
+    @property
+    def data(self):
+        return self._force().data
+
+    @property
+    def offsets(self):
+        return self._force().offsets
+
+    @property
+    def validity(self):
+        return self._force().validity
+
+    @property
+    def children(self):
+        return self._force().children
+
+    @property
+    def num_rows(self) -> int:
+        return self._n          # static: no forcing to answer len()
+
+    def tree_flatten(self):
+        return self._force().tree_flatten()
+
+    @classmethod
+    def tree_unflatten(cls, dtype, leaves):
+        return Column.tree_unflatten(dtype, leaves)
+
+
+def force_column(col: Column) -> Column:
+    """The eager form of ``col`` (materializes a :class:`LazyColumn`)."""
+    return col._force() if isinstance(col, LazyColumn) else col
+
+
 def _column_from_pylist(values, dtype: T.DType | None = None) -> Column:
     """Build a column from a flat host list, inferring the type if needed."""
     if dtype is not None and dtype.id == T.TypeId.LIST:

@@ -37,6 +37,14 @@ import jax
 from ..utils import syncs
 
 
+def _materialized(result):
+    """Force every deferred column in the result WHILE the capture/replay
+    context is still active: a ``LazyColumn`` forced later (e.g. by jit's
+    own output flattening) would resolve its string-size syncs outside the
+    context and desynchronize the tape."""
+    return jax.tree_util.tree_map(lambda x: x, result)
+
+
 class CompiledQuery:
     """A query function compiled to one jitted program over its tables.
 
@@ -47,13 +55,14 @@ class CompiledQuery:
     def __init__(self, qfn: Callable, tables: Any):
         tape: list[int] = []
         with syncs.capture(tape):
-            self.expected = qfn(tables)     # eager capture run (and oracle)
+            # eager capture run (and oracle)
+            self.expected = _materialized(qfn(tables))
         self.tape = tuple(tape)
         qname = getattr(qfn, "__name__", "query")
 
         def _traced(tbls):
             with syncs.replay(list(self.tape)):
-                return qfn(tbls)
+                return _materialized(qfn(tbls))
         _traced.__name__ = f"compiled_{qname}"
         self._prog = jax.jit(_traced)
 

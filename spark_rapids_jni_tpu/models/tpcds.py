@@ -21,9 +21,11 @@ import numpy as np
 
 from .. import types as T
 from ..column import Column, Table
-from ..ops import (apply_boolean_mask, concat_tables, distinct,
-                   groupby_aggregate, groupby_nunique, inner_join, isin,
-                   left_join, mean, slice_table, sort_table)
+from ..ops import (anti_join, apply_boolean_mask, concat_tables, distinct,
+                   fill_null, full_outer_join, groupby_aggregate,
+                   groupby_cube, groupby_grouping_sets, groupby_nunique,
+                   groupby_rollup, inner_join, isin, left_join, mean,
+                   semi_join, slice_table, sort_table, sum_)
 from ..ops import strings as S
 from ..ops import window as W
 from ..parquet import device_scan as decode  # device fast path, host fallback
@@ -389,20 +391,19 @@ def q_having(tables: dict[str, Table], min_total: float = 1000.0) -> Table:
     """GROUP BY brand HAVING SUM(price) > threshold (Q23 HAVING shape):
     aggregate, then filter on the aggregate.
 
-    Projection pushdown (what Spark's optimizer does before the exchange):
-    this is an UNFILTERED full-fact join, so only the join key, the measure,
-    and the group column enter it — materializing all 16 joined columns at
-    SF1 allocates multiple GB of string gathers for columns the query never
-    reads (measured: it OOM-crashed the chip at 10M rows).
+    Deliberately UN-projected: this is a full-fact join of all 16 columns.
+    Projection happens structurally — join outputs are deferred
+    (``ops.filter.gather`` returns ``LazyColumn``s), so only the three
+    columns the aggregate reads are ever gathered; the 13 unreferenced
+    ones (including every string column's multi-GB gather at SF1, which
+    used to OOM the worker) never materialize.
     """
     ss, item = tables["store_sales"], tables["item"]
-    ssp = Table([ss[_col(SS_COLS, "ss_item_sk")],
-                 ss[_col(SS_COLS, "ss_ext_sales_price")]])
-    itp = Table([item[_col(ITEM_COLS, "i_item_sk")],
-                 item[_col(ITEM_COLS, "i_brand_id")]])
-    j = inner_join(ssp, itp, 0, 0)
-    # j columns: [ss_item_sk, price, i_item_sk, i_brand_id]
-    rev = groupby_aggregate(j, [3], [(1, "sum")])
+    j = inner_join(ss, item, _col(SS_COLS, "ss_item_sk"),
+                   _col(ITEM_COLS, "i_item_sk"))
+    cols = SS_COLS + ITEM_COLS
+    rev = groupby_aggregate(j, [cols.index("i_brand_id")],
+                            [(cols.index("ss_ext_sales_price"), "sum")])
     keep = rev[1].values() > min_total
     return sort_table(apply_boolean_mask(rev, keep), [0])
 
@@ -450,6 +451,347 @@ def q_isin_states(tables: dict[str, Table],
                       "ss_ext_sales_price")
 
 
+# ---------------------------------------------------------------------------
+# round-4 breadth: rollup / grouping sets / cube, multi-fact outer joins,
+# disjunctive bands, semi/anti, selection aggregates, window dedup
+# ---------------------------------------------------------------------------
+
+def q36_rollup(tables: dict[str, Table]) -> Table:
+    """ROLLUP(i_category, i_brand) revenue (Q36 shape): per-(category,
+    brand) sums, per-category subtotals, and the grand total, with Spark's
+    grouping_id in the last column."""
+    ss, item = tables["store_sales"], tables["item"]
+    j = inner_join(ss, item, _col(SS_COLS, "ss_item_sk"),
+                   _col(ITEM_COLS, "i_item_sk"))
+    cols = SS_COLS + ITEM_COLS
+    out = groupby_rollup(
+        j, [cols.index("i_category"), cols.index("i_brand")],
+        [(cols.index("ss_ext_sales_price"), "sum")])
+    # [i_category, i_brand, sum, grouping_id] — detail rows first, then
+    # subtotals, then the grand total, keys ordered within each level
+    return sort_table(out, [3, 0, 1])
+
+
+def q86_rollup(tables: dict[str, Table]) -> Table:
+    """ROLLUP(d_year, d_moy) revenue (Q86 shape: time-hierarchy rollup)."""
+    ss, dd = tables["store_sales"], tables["date_dim"]
+    j = inner_join(ss, dd, _col(SS_COLS, "ss_sold_date_sk"),
+                   _col(DATE_COLS, "d_date_sk"))
+    cols = SS_COLS + DATE_COLS
+    out = groupby_rollup(
+        j, [cols.index("d_year"), cols.index("d_moy")],
+        [(cols.index("ss_ext_sales_price"), "sum")])
+    return sort_table(out, [3, 0, 1])
+
+
+def q27_cube(tables: dict[str, Table]) -> Table:
+    """CUBE(i_category, s_state) average quantity (Q27 shape: cube over
+    item × store geography)."""
+    ss, item, store = (tables["store_sales"], tables["item"],
+                       tables["store"])
+    j1 = inner_join(ss, item, _col(SS_COLS, "ss_item_sk"),
+                    _col(ITEM_COLS, "i_item_sk"))
+    cols1 = SS_COLS + ITEM_COLS
+    j2 = inner_join(j1, store, cols1.index("ss_store_sk"),
+                    _col(STORE_COLS, "s_store_sk"))
+    cols = cols1 + STORE_COLS
+    out = groupby_cube(
+        j2, [cols.index("i_category"), cols.index("s_state")],
+        [(cols.index("ss_quantity"), "mean"),
+         (cols.index("ss_ext_sales_price"), "sum")])
+    return sort_table(out, [4, 0, 1])
+
+
+def q5_grouping_sets(tables: dict[str, Table]) -> Table:
+    """Channel roll-report (Q5 shape): store + web revenue unioned with a
+    channel tag, GROUPING SETS ((channel, category), (channel), ())."""
+    ss, ws, item = (tables["store_sales"], tables["web_sales"],
+                    tables["item"])
+    part_s = Table([ss[_col(SS_COLS, "ss_item_sk")],
+                    ss[_col(SS_COLS, "ss_ext_sales_price")],
+                    Column(T.int32,
+                           jnp.zeros(ss.num_rows, jnp.int32))])
+    part_w = Table([ws[_col(WS_COLS, "ws_item_sk")],
+                    ws[_col(WS_COLS, "ws_ext_sales_price")],
+                    Column(T.int32,
+                           jnp.ones(ws.num_rows, jnp.int32))])
+    both = concat_tables([part_s, part_w])
+    j = inner_join(both, item, 0, _col(ITEM_COLS, "i_item_sk"))
+    cols = ["item_sk", "price", "channel"] + ITEM_COLS
+    out = groupby_grouping_sets(
+        j, [cols.index("channel"), cols.index("i_category")],
+        [[0, 1], [0], []], [(cols.index("price"), "sum")])
+    return sort_table(out, [3, 0, 1])
+
+
+def q78_outer(tables: dict[str, Table]) -> Table:
+    """Multi-fact FULL OUTER join (Q78 shape): per-item store revenue vs
+    web revenue, keeping items that sold in either channel; missing-side
+    revenue coalesces to 0."""
+    ss, ws = tables["store_sales"], tables["web_sales"]
+    s_rev = groupby_aggregate(ss, [_col(SS_COLS, "ss_item_sk")],
+                              [(_col(SS_COLS, "ss_ext_sales_price"), "sum")])
+    w_rev = groupby_aggregate(ws, [_col(WS_COLS, "ws_item_sk")],
+                              [(_col(WS_COLS, "ws_ext_sales_price"), "sum")])
+    j = full_outer_join(s_rev, w_rev, 0, 0)
+    # [s_item, s_sum, w_item, w_sum] — coalesce(s_item, w_item), zero-fill
+    # revenue; the validity must be read BEFORE any fill
+    left_valid = j[0].validity_or_true()
+    key = Column(j[0].dtype,
+                 jnp.where(left_valid, j[0].data, j[2].data))
+    out = Table([key, fill_null(j[1], 0.0), fill_null(j[3], 0.0)])
+    return sort_table(out, [0])
+
+
+def q25_two_fact(tables: dict[str, Table], year: int = 2000) -> Table:
+    """Two-fact inner join (Q25 shape): items sold in BOTH channels in one
+    year, with each channel's revenue."""
+    ss, ws, dd = (tables["store_sales"], tables["web_sales"],
+                  tables["date_dim"])
+    dd_f = apply_boolean_mask(
+        dd, _eq_scalar_mask(dd[_col(DATE_COLS, "d_year")], year))
+    js = inner_join(ss, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
+                    _col(DATE_COLS, "d_date_sk"))
+    jw = inner_join(ws, dd_f, _col(WS_COLS, "ws_sold_date_sk"),
+                    _col(DATE_COLS, "d_date_sk"))
+    s_rev = groupby_aggregate(
+        js, [_col(SS_COLS, "ss_item_sk")],
+        [(SS_COLS.index("ss_ext_sales_price"), "sum")])
+    w_rev = groupby_aggregate(
+        jw, [_col(WS_COLS, "ws_item_sk")],
+        [(WS_COLS.index("ws_ext_sales_price"), "sum")])
+    j = inner_join(s_rev, w_rev, 0, 0)
+    return sort_table(Table([j[0], j[1], j[3]]), [0])
+
+
+def q88_counts(tables: dict[str, Table]) -> Table:
+    """Multi-band count report (Q88 shape): one row of sale counts in four
+    quantity bands — four masked counts in one pass."""
+    ss = tables["store_sales"]
+    q = ss[_col(SS_COLS, "ss_quantity")]
+    qv, val = q.data, q.validity_or_true()
+    cols = []
+    for lo, hi in [(1, 25), (26, 50), (51, 75), (76, 100)]:
+        m = val & (qv >= lo) & (qv <= hi)
+        cols.append(Column(T.int64,
+                           jnp.sum(m.astype(jnp.int64))[None]))
+    return Table(cols)
+
+
+def q90_ratio(tables: dict[str, Table]) -> Table:
+    """Count-ratio report (Q90 shape): first-half vs second-half-of-year
+    sale counts and their ratio, one output row."""
+    ss, dd = tables["store_sales"], tables["date_dim"]
+    j = inner_join(ss, dd, _col(SS_COLS, "ss_sold_date_sk"),
+                   _col(DATE_COLS, "d_date_sk"))
+    cols = SS_COLS + DATE_COLS
+    moy = j[cols.index("d_moy")]
+    mv, val = moy.data, moy.validity_or_true()
+    am = jnp.sum((val & (mv <= 6)).astype(jnp.int64))
+    pm = jnp.sum((val & (mv > 6)).astype(jnp.int64))
+    ratio = am.astype(jnp.float64) / jnp.maximum(pm, 1).astype(jnp.float64)
+    return Table([Column(T.int64, am[None]), Column(T.int64, pm[None]),
+                  Column.from_values(T.float64, ratio[None])])
+
+
+def q29_minmax(tables: dict[str, Table]) -> Table:
+    """Selection-aggregate profile (Q29 shape): min/max/mean quantity per
+    brand."""
+    ss, item = tables["store_sales"], tables["item"]
+    j = inner_join(ss, item, _col(SS_COLS, "ss_item_sk"),
+                   _col(ITEM_COLS, "i_item_sk"))
+    cols = SS_COLS + ITEM_COLS
+    qi = cols.index("ss_quantity")
+    out = groupby_aggregate(j, [cols.index("i_brand_id")],
+                            [(qi, "min"), (qi, "max"), (qi, "mean")])
+    return sort_table(out, [0])
+
+
+def q48_bands(tables: dict[str, Table]) -> Table:
+    """Disjunctive band predicate (Q48/Q13 shape): (qty in [1,20] AND
+    price < $50) OR (qty in [41,60] AND price > $150), total quantity per
+    state."""
+    ss, store = tables["store_sales"], tables["store"]
+    q = ss[_col(SS_COLS, "ss_quantity")]
+    p = ss[_col(SS_COLS, "ss_sales_price_cents")]
+    qv, pv = q.data, p.data
+    val = q.validity_or_true() & p.validity_or_true()
+    m = val & (((qv >= 1) & (qv <= 20) & (pv < 50_00))
+               | ((qv >= 41) & (qv <= 60) & (pv > 150_00)))
+    ss_f = apply_boolean_mask(ss, m)
+    j = inner_join(ss_f, store, _col(SS_COLS, "ss_store_sk"),
+                   _col(STORE_COLS, "s_store_sk"))
+    cols = SS_COLS + STORE_COLS
+    out = groupby_aggregate(j, [cols.index("s_state")],
+                            [(cols.index("ss_quantity"), "sum")])
+    return sort_table(out, [0])
+
+
+def q13_avg_bands(tables: dict[str, Table]) -> Table:
+    """Per-band averages in one pass (Q13 shape): average sales price in
+    three disjoint quantity bands, one output row."""
+    ss = tables["store_sales"]
+    q = ss[_col(SS_COLS, "ss_quantity")]
+    p = ss[_col(SS_COLS, "ss_sales_price_cents")]
+    qv = q.data
+    val = q.validity_or_true() & p.validity_or_true()
+    pc = p.data.astype(jnp.float64)
+    cols = []
+    for lo, hi in [(1, 33), (34, 66), (67, 100)]:
+        m = val & (qv >= lo) & (qv <= hi)
+        cnt = jnp.maximum(jnp.sum(m.astype(jnp.int64)), 1)
+        avg = jnp.sum(jnp.where(m, pc, 0.0)) / cnt.astype(jnp.float64)
+        cols.append(Column.from_values(T.float64, (avg / 100.0)[None]))
+    return Table(cols)
+
+
+def q96_count(tables: dict[str, Table], year: int = 2000,
+              qty_min: int = 80) -> Table:
+    """Plain filtered count (Q96 shape): high-quantity sales in one year."""
+    ss, dd = tables["store_sales"], tables["date_dim"]
+    ss_f = apply_boolean_mask(
+        ss, _range_mask(ss[_col(SS_COLS, "ss_quantity")], qty_min))
+    dd_f = apply_boolean_mask(
+        dd, _eq_scalar_mask(dd[_col(DATE_COLS, "d_year")], year))
+    j = inner_join(ss_f, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
+                   _col(DATE_COLS, "d_date_sk"))
+    cols = SS_COLS + DATE_COLS
+    qsum = sum_(j[cols.index("ss_quantity")])
+    return Table([Column(T.int64, jnp.asarray([j.num_rows], jnp.int64)),
+                  Column(T.int64, qsum[None].astype(jnp.int64))])
+
+
+def q23_semi(tables: dict[str, Table], min_sales: int = 30) -> Table:
+    """Frequent-item semi join (Q23 shape): revenue from sales of items
+    with more than ``min_sales`` transactions."""
+    ss = tables["store_sales"]
+    freq = groupby_aggregate(ss, [_col(SS_COLS, "ss_item_sk")],
+                             [(_col(SS_COLS, "ss_item_sk"), "count")])
+    freq_f = apply_boolean_mask(freq, freq[1].data > min_sales)
+    hits = semi_join(ss, freq_f, _col(SS_COLS, "ss_item_sk"), 0)
+    total = sum_(hits[_col(SS_COLS, "ss_ext_sales_price")])
+    return Table([Column.from_values(T.float64, total[None]),
+                  Column(T.int64, jnp.asarray([hits.num_rows], jnp.int64))])
+
+
+def q16_anti(tables: dict[str, Table]) -> Table:
+    """Never-sold anti join (Q16/Q87 shape): items with zero store sales."""
+    ss, item = tables["store_sales"], tables["item"]
+    unsold = anti_join(item, ss, _col(ITEM_COLS, "i_item_sk"),
+                       _col(SS_COLS, "ss_item_sk"))
+    return sort_table(
+        Table([unsold[_col(ITEM_COLS, "i_item_sk")],
+               unsold[_col(ITEM_COLS, "i_manufact_id")]]), [0])
+
+
+def q_minmax_price(tables: dict[str, Table]) -> Table:
+    """Decimal selection aggregates: min/max i_current_price (decimal32)
+    per category."""
+    item = tables["item"]
+    pi = _col(ITEM_COLS, "i_current_price")
+    out = groupby_aggregate(item, [_col(ITEM_COLS, "i_category")],
+                            [(pi, "min"), (pi, "max")])
+    return sort_table(out, [0])
+
+
+def q_multi_measure(tables: dict[str, Table]) -> Table:
+    """Wide measure set per store: quantity sum, decimal sales sum, mean
+    list price — one groupby, three measure types."""
+    ss = tables["store_sales"]
+    price_i = _col(SS_COLS, "ss_sales_price_cents")
+    work = list(ss.columns)
+    work[price_i] = Column(T.decimal64(-2), ss[price_i].data,
+                           validity=ss[price_i].validity)
+    out = groupby_aggregate(
+        Table(work), [_col(SS_COLS, "ss_store_sk")],
+        [(_col(SS_COLS, "ss_quantity"), "sum"), (price_i, "sum"),
+         (_col(SS_COLS, "ss_list_price_cents"), "mean")])
+    return sort_table(out, [0])
+
+
+def q_rollup3(tables: dict[str, Table]) -> Table:
+    """Three-level ROLLUP(d_year, d_moy, s_state) revenue — the deep
+    hierarchy variant."""
+    ss, dd, store = (tables["store_sales"], tables["date_dim"],
+                     tables["store"])
+    j1 = inner_join(ss, dd, _col(SS_COLS, "ss_sold_date_sk"),
+                    _col(DATE_COLS, "d_date_sk"))
+    cols1 = SS_COLS + DATE_COLS
+    j2 = inner_join(j1, store, cols1.index("ss_store_sk"),
+                    _col(STORE_COLS, "s_store_sk"))
+    cols = cols1 + STORE_COLS
+    out = groupby_rollup(
+        j2, [cols.index("d_year"), cols.index("d_moy"),
+             cols.index("s_state")],
+        [(cols.index("ss_ext_sales_price"), "sum")])
+    return sort_table(out, [4, 0, 1, 2])
+
+
+def q_first_last(tables: dict[str, Table]) -> Table:
+    """FIRST/LAST by time per item (Q64-family shape): each item's first
+    and last sale price when ordered by date."""
+    ss = tables["store_sales"]
+    srt = sort_table(ss, [_col(SS_COLS, "ss_sold_date_sk")])
+    pi = _col(SS_COLS, "ss_sales_price_cents")
+    out = groupby_aggregate(srt, [_col(SS_COLS, "ss_item_sk")],
+                            [(pi, "first"), (pi, "last")])
+    return sort_table(out, [0])
+
+
+def q_rownum_dedup(tables: dict[str, Table], keep: int = 2) -> Table:
+    """ROW_NUMBER dedup (Q67-family): keep each store's ``keep``
+    highest-revenue months."""
+    ss, dd = tables["store_sales"], tables["date_dim"]
+    j = inner_join(ss, dd, _col(SS_COLS, "ss_sold_date_sk"),
+                   _col(DATE_COLS, "d_date_sk"))
+    cols = SS_COLS + DATE_COLS
+    rev = groupby_aggregate(
+        j, [cols.index("ss_store_sk"), cols.index("d_moy")],
+        [(cols.index("ss_ext_sales_price"), "sum")])
+    spec = W.WindowSpec(rev, partition_by=[0], order_by_keys=[2, 1],
+                        ascending=[False, True])
+    rn = W.row_number(spec)
+    out = apply_boolean_mask(Table(list(rev.columns) + [rn]),
+                             rn.values() <= keep)
+    return sort_table(out, [0, 3])
+
+
+def q_cross_ratio(tables: dict[str, Table]) -> Table:
+    """Channel revenue ratio per category: web revenue / store revenue
+    where both channels sold (aggregate-join-aggregate shape)."""
+    ss, ws, item = (tables["store_sales"], tables["web_sales"],
+                    tables["item"])
+    js = inner_join(ss, item, _col(SS_COLS, "ss_item_sk"),
+                    _col(ITEM_COLS, "i_item_sk"))
+    jw = inner_join(ws, item, _col(WS_COLS, "ws_item_sk"),
+                    _col(ITEM_COLS, "i_item_sk"))
+    cs = SS_COLS + ITEM_COLS
+    cw = WS_COLS + ITEM_COLS
+    s_rev = groupby_aggregate(js, [cs.index("i_category")],
+                              [(cs.index("ss_ext_sales_price"), "sum")])
+    w_rev = groupby_aggregate(jw, [cw.index("i_category")],
+                              [(cw.index("ws_ext_sales_price"), "sum")])
+    j = inner_join(s_rev, w_rev, 0, 0)
+    ratio = Column.from_values(
+        T.float64, j[3].values() / j[1].values())
+    return sort_table(Table([j[0], j[1], j[3], ratio]), [0])
+
+
+def q_null_share(tables: dict[str, Table]) -> Table:
+    """Null accounting per category (COUNT(*) vs COUNT(col) semantics):
+    web sales row count vs non-null price count."""
+    ws, item = tables["web_sales"], tables["item"]
+    j = inner_join(ws, item, _col(WS_COLS, "ws_item_sk"),
+                   _col(ITEM_COLS, "i_item_sk"))
+    cols = WS_COLS + ITEM_COLS
+    out = groupby_aggregate(
+        j, [cols.index("i_category")],
+        [(cols.index("ws_item_sk"), "count"),
+         (cols.index("ws_ext_sales_price"), "count"),
+         (cols.index("ws_ext_sales_price"), "sum")])
+    return sort_table(out, [0])
+
+
 QUERIES = {"q3": q3, "q42": q42, "q52": q52, "q55": q55,
            "q_state_rollup": q_state_rollup, "q7": q7, "q19": q19,
            "q62": q62, "q52_topn": q52_topn, "q65": q65,
@@ -459,10 +801,26 @@ QUERIES = {"q3": q3, "q42": q42, "q52": q52, "q55": q55,
            "q_running_share": q_running_share,
            "q_nunique_items": q_nunique_items, "q_having": q_having,
            "q_case_when": q_case_when, "q_distinct_pairs": q_distinct_pairs,
-           "q_isin_states": q_isin_states}
+           "q_isin_states": q_isin_states,
+           # round-4 breadth
+           "q36_rollup": q36_rollup, "q86_rollup": q86_rollup,
+           "q27_cube": q27_cube, "q5_grouping_sets": q5_grouping_sets,
+           "q78_outer": q78_outer, "q25_two_fact": q25_two_fact,
+           "q88_counts": q88_counts, "q90_ratio": q90_ratio,
+           "q29_minmax": q29_minmax, "q48_bands": q48_bands,
+           "q13_avg_bands": q13_avg_bands, "q96_count": q96_count,
+           "q23_semi": q23_semi, "q16_anti": q16_anti,
+           "q_minmax_price": q_minmax_price,
+           "q_multi_measure": q_multi_measure, "q_rollup3": q_rollup3,
+           "q_first_last": q_first_last, "q_rownum_dedup": q_rownum_dedup,
+           "q_cross_ratio": q_cross_ratio, "q_null_share": q_null_share}
+
+# queries that read the second fact table (skipped when absent)
+_NEEDS_WEB = {"q_union_channels", "q5_grouping_sets", "q78_outer",
+              "q25_two_fact", "q_cross_ratio", "q_null_share"}
 
 
 def run_all(files: dict[str, bytes]) -> dict[str, Table]:
     tables = load_tables(files)
     return {name: fn(tables) for name, fn in QUERIES.items()
-            if name != "q_union_channels" or "web_sales" in tables}
+            if name not in _NEEDS_WEB or "web_sales" in tables}

@@ -36,6 +36,11 @@ void* srjt_column_fixed(int32_t type_id, int32_t scale, int64_t n_rows,
 void* srjt_column_string(int64_t n_rows, const int32_t* offsets,
                          const uint8_t* chars, const uint8_t* valid);
 void srjt_column_free(void* h);
+int64_t srjt_column_rows(void* h);
+const uint8_t* srjt_column_data(void* h);
+int64_t srjt_column_data_size(void* h);
+const int32_t* srjt_column_offsets(void* h);
+const uint8_t* srjt_column_valid(void* h);
 void* srjt_to_rows(void* table);
 void* srjt_rows_import(const uint8_t* data, int64_t size,
                        const int32_t* offsets, int64_t n_rows);
@@ -105,6 +110,37 @@ JNIEXPORT jlong JNICALL Java_com_tpu_rapids_jni_HostColumn_makeString(
 JNIEXPORT void JNICALL Java_com_tpu_rapids_jni_HostColumn_close(
     JNIEnv*, jclass, jlong handle) {
   srjt_column_free(reinterpret_cast<void*>(handle));
+}
+
+// Readback surface (the reference verifies through cudf's copy-to-host
+// accessors; these expose the same via the srjt C API).
+
+JNIEXPORT jlong JNICALL Java_com_tpu_rapids_jni_HostColumn_rows(
+    JNIEnv*, jclass, jlong handle) {
+  return srjt_column_rows(reinterpret_cast<void*>(handle));
+}
+
+JNIEXPORT jlong JNICALL Java_com_tpu_rapids_jni_HostColumn_dataSize(
+    JNIEnv*, jclass, jlong handle) {
+  return srjt_column_data_size(reinterpret_cast<void*>(handle));
+}
+
+JNIEXPORT jlong JNICALL Java_com_tpu_rapids_jni_HostColumn_dataAddress(
+    JNIEnv*, jclass, jlong handle) {
+  return reinterpret_cast<jlong>(
+      srjt_column_data(reinterpret_cast<void*>(handle)));
+}
+
+JNIEXPORT jlong JNICALL Java_com_tpu_rapids_jni_HostColumn_offsetsAddress(
+    JNIEnv*, jclass, jlong handle) {
+  return reinterpret_cast<jlong>(
+      srjt_column_offsets(reinterpret_cast<void*>(handle)));
+}
+
+JNIEXPORT jlong JNICALL Java_com_tpu_rapids_jni_HostColumn_validAddress(
+    JNIEnv*, jclass, jlong handle) {
+  return reinterpret_cast<jlong>(
+      srjt_column_valid(reinterpret_cast<void*>(handle)));
 }
 
 // ---- com.tpu.rapids.jni.HostTable ----------------------------------------

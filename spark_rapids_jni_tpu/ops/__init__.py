@@ -6,7 +6,8 @@ from .filter import (apply_boolean_mask, fill_null, gather,  # noqa: F401
                      isin, mask_table)
 from .copying import concat_tables, slice_table  # noqa: F401
 from .groupby import (distinct, groupby_aggregate,  # noqa: F401
-                      groupby_nunique)
+                      groupby_cube, groupby_grouping_sets, groupby_nunique,
+                      groupby_rollup)
 from .join import (anti_join, full_outer_join, inner_join,  # noqa: F401
                    join_indices, left_join, right_join, semi_join)
 from .scan import (cumulative_count, cumulative_max,  # noqa: F401

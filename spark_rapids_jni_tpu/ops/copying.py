@@ -53,7 +53,12 @@ def _rebase_offsets(cols: Sequence[Column]) -> jnp.ndarray:
 
 
 def concat_tables(tables: Sequence[Table]) -> Table:
-    """Row-wise concatenation (cudf::concatenate analog)."""
+    """Row-wise concatenation (cudf::concatenate analog).
+
+    Columns are deferred (see ``ops.filter.gather``): concatenating lazy
+    join outputs must not force columns the plan never reads.
+    """
+    from ..column import LazyColumn
     tables = list(tables)
     if not tables:
         raise ValueError("concat_tables needs at least one table")
@@ -61,8 +66,19 @@ def concat_tables(tables: Sequence[Table]) -> Table:
     for t in tables:
         if t.num_columns != ncols:
             raise ValueError("concat_tables: column count mismatch")
-    return Table([_concat_columns([t[i] for t in tables])
-                  for i in range(ncols)])
+    for i in range(ncols):
+        dt = tables[0][i].dtype
+        for t in tables[1:]:
+            if t[i].dtype != dt:
+                # schema errors must surface at the call site, not when a
+                # deferred column is eventually forced
+                raise TypeError(
+                    f"concat dtype mismatch: {t[i].dtype} vs {dt}")
+    n_out = sum(t.num_rows for t in tables)
+    return Table([
+        LazyColumn(tables[0][i].dtype, n_out,
+                   (lambda i=i: _concat_columns([t[i] for t in tables])))
+        for i in range(ncols)])
 
 
 def _slice_column(col: Column, start: int, stop: int) -> Column:

@@ -52,8 +52,21 @@ def _gather_column(col: Column, idx: jnp.ndarray) -> Column:
 
 
 def gather(table: Table, idx: jnp.ndarray) -> Table:
-    """Gather rows by index (libcudf gather analog)."""
-    return Table([_gather_column(c, idx) for c in table.columns])
+    """Gather rows by index (libcudf gather analog).
+
+    Columns come back LAZY (:class:`~..column.LazyColumn`): each
+    materializes on first payload access, so plan tails that only read a
+    few columns never pay the others' gathers — or, for string columns,
+    their size-resolution syncs.  This is the structural projection pass
+    that keeps wide joins from materializing (and OOMing on) columns the
+    query never references.
+    """
+    from ..column import LazyColumn
+    n_out = int(idx.shape[0])
+    return Table([
+        LazyColumn(c.dtype, n_out,
+                   (lambda c=c: _gather_column(c, idx)))
+        for c in table.columns])
 
 
 def apply_boolean_mask(table: Table, mask: jnp.ndarray) -> Table:
@@ -68,13 +81,19 @@ def mask_table(table: Table, mask: jnp.ndarray) -> Table:
     """Filter without compaction: failing rows become invalid (null).
 
     Static-shaped, fully jittable; downstream reductions/groupbys honor
-    validity so results match the compacting filter.
+    validity so results match the compacting filter.  Deferred per column
+    (see ``gather``) so masking a wide table doesn't force unread columns.
     """
-    cols = []
-    for c in table.columns:
-        v = mask if c.validity is None else (c.validity & mask)
-        cols.append(Column(c.dtype, c.data, c.offsets, v, c.children))
-    return Table(cols)
+    from ..column import LazyColumn, force_column
+
+    def mk(c):
+        def thunk(c=c):
+            g = force_column(c)
+            v = mask if g.validity is None else (g.validity & mask)
+            return Column(g.dtype, g.data, g.offsets, v, g.children)
+        return LazyColumn(c.dtype, c.num_rows, thunk)
+
+    return Table([mk(c) for c in table.columns])
 
 
 def fill_null(col: Column, value) -> Column:

@@ -147,7 +147,11 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
     """
     n = table.num_rows
     if n == 0:
-        # Spark returns an empty result for GROUP BY over no rows
+        if not key_indices:
+            # GROUP BY () over an empty relation: Spark still emits ONE
+            # grand-total row (count = 0, other aggregates null)
+            return _grand_total_empty(table, aggs)
+        # keyed GROUP BY over no rows: empty result (Spark semantics)
         return _empty_result(table, key_indices, aggs)
     # string keys: swap in order-preserving dictionary codes (ops.strings) so
     # ordering/segmenting below see plain int32 lanes; the output key columns
@@ -165,6 +169,11 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
             work_cols[ki] = codes
             str_dicts[ki] = uniq
     table = Table(work_cols)
+    if not key_indices:
+        # GROUP BY () — the grand-total grouping set: one segment, no sort
+        sorted_tbl = table
+        seg_ids = jnp.zeros(n, dtype=jnp.int32)
+        return _aggregate_sorted(sorted_tbl, [], {}, seg_ids, 1, aggs, n)
     order = order_by(table, list(key_indices))
     sorted_tbl = gather(table, order)
 
@@ -187,7 +196,14 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
     seg_ids = _segment_ids(skeys, svalid)
     from ..utils import syncs
     num_segments = syncs.scalar(seg_ids[-1]) + 1   # scalar sync (group count)
+    return _aggregate_sorted(sorted_tbl, list(key_indices), str_dicts,
+                             seg_ids, num_segments, aggs, n)
 
+
+def _aggregate_sorted(sorted_tbl: Table, key_indices, str_dicts,
+                      seg_ids, num_segments: int, aggs, n: int) -> Table:
+    """Aggregation tail shared by the keyed and grand-total (no-key) paths:
+    per-segment key heads + aggregate columns over a key-sorted table."""
     # one representative row per segment for the key columns
     head_pos = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int32), seg_ids,
                                    num_segments)
@@ -310,9 +326,83 @@ def _empty_result(table: Table, key_indices, aggs) -> Table:
     return Table(cols)
 
 
+def _grand_total_empty(table: Table, aggs) -> Table:
+    """One grand-total row over zero input rows: COUNT = 0 (valid), every
+    other aggregate null."""
+    cols = []
+    for vi, agg in aggs:
+        dt = _agg_out_dtype(table[vi].dtype, agg)
+        if agg == "count":
+            cols.append(Column(dt, jnp.zeros(1, dt.storage)))
+            continue
+        proto = _empty_column_of(dt)
+        shape = (1,) + proto.data.shape[1:]
+        cols.append(Column(dt, jnp.zeros(shape, proto.data.dtype),
+                           validity=jnp.zeros(1, jnp.bool_)))
+    return Table(cols)
+
+
 def _take_rows(col: Column, idx: jnp.ndarray) -> Column:
     v = None if col.validity is None else col.validity[idx]
     return Column(col.dtype, col.data[idx], validity=v)
+
+
+def groupby_grouping_sets(table: Table, key_indices: Sequence[int],
+                          sets: Sequence[Sequence[int]],
+                          aggs: Sequence[tuple[int, str]]) -> Table:
+    """GROUP BY GROUPING SETS (Spark/libcudf groupby with grouping sets).
+
+    ``sets`` holds positions INTO ``key_indices`` (e.g. rollup over keys
+    [a, b] is ``[[0, 1], [0], []]``).  Output schema: every key column (null
+    where the set aggregates it away), then the agg columns, then a
+    ``grouping_id`` int64 column (Spark's bigint grouping_id) — bit ``k``
+    (MSB = first key) set when key ``k`` is NOT in the set.  One sorted
+    ``groupby_aggregate`` per set, results unioned; callers order the
+    result (deterministic given a sort, as elsewhere).
+    """
+    from .copying import concat_tables
+    from .join import _null_column
+    key_indices = list(key_indices)
+    nk = len(key_indices)
+    parts = []
+    for s in sets:
+        included = sorted(s)
+        sub = groupby_aggregate(table, [key_indices[i] for i in included],
+                                aggs)
+        n = sub.num_rows
+        gid = 0
+        cols: list[Column] = []
+        for k in range(nk):
+            if k in included:
+                cols.append(sub[included.index(k)])
+            else:
+                gid |= 1 << (nk - 1 - k)
+                cols.append(_null_column(table[key_indices[k]].dtype, n))
+        for ai in range(len(aggs)):
+            cols.append(sub[len(included) + ai])
+        cols.append(Column(T.int64, jnp.full((n,), gid, jnp.int64)))
+        parts.append(Table(cols))
+    return concat_tables(parts)
+
+
+def groupby_rollup(table: Table, key_indices: Sequence[int],
+                   aggs: Sequence[tuple[int, str]]) -> Table:
+    """GROUP BY ROLLUP (Spark rollup): grouping sets over every key-list
+    prefix, from all keys down to the grand total."""
+    nk = len(key_indices)
+    sets = [list(range(k)) for k in range(nk, -1, -1)]
+    return groupby_grouping_sets(table, key_indices, sets, aggs)
+
+
+def groupby_cube(table: Table, key_indices: Sequence[int],
+                 aggs: Sequence[tuple[int, str]]) -> Table:
+    """GROUP BY CUBE (Spark cube): grouping sets over every key subset."""
+    import itertools
+    nk = len(key_indices)
+    sets = []
+    for r in range(nk, -1, -1):
+        sets.extend(itertools.combinations(range(nk), r))
+    return groupby_grouping_sets(table, key_indices, sets, aggs)
 
 
 def groupby_nunique(table: Table, key_indices: Sequence[int],

@@ -73,10 +73,12 @@ def join_indices(left: Column, right: Column,
     if lvalid is not None:
         counts = jnp.where(lvalid, counts, 0)
 
-    if how == "semi":
-        return jnp.nonzero(counts > 0)[0]
-    if how == "anti":
-        return jnp.nonzero(counts == 0)[0]
+    if how in ("semi", "anti"):
+        # two-phase like every dynamic size (count sync → sized nonzero) so
+        # the whole plan stays traceable under capture/replay
+        m = (counts > 0) if how == "semi" else (counts == 0)
+        k = syncs.scalar(jnp.sum(m))
+        return jnp.nonzero(m, size=k)[0]
 
     if how == "left":
         out_counts = jnp.maximum(counts, 1)   # unmatched keep one row
@@ -120,6 +122,8 @@ def _empty_column(dt) -> Column:
         return Column(dt, jnp.zeros(0, jnp.uint8), jnp.zeros(1, jnp.int32))
     if dt.id == T.TypeId.DECIMAL128:
         return Column(dt, jnp.zeros((0, 2), jnp.int64))
+    if dt.id == T.TypeId.FLOAT64:     # bit-pair storage invariant
+        return Column(dt, jnp.zeros((0, 2), jnp.uint32))
     return Column(dt, jnp.zeros(0, dt.storage))
 
 
@@ -138,6 +142,8 @@ def _null_column(dt, n: int) -> Column:
                       jnp.zeros(n + 1, jnp.int32), nulls)
     if dt.id == T.TypeId.DECIMAL128:
         return Column(dt, jnp.zeros((n, 2), jnp.int64), validity=nulls)
+    if dt.id == T.TypeId.FLOAT64:     # bit-pair storage invariant
+        return Column(dt, jnp.zeros((n, 2), jnp.uint32), validity=nulls)
     return Column(dt, jnp.zeros(n, dt.storage), validity=nulls)
 
 
@@ -151,11 +157,19 @@ def left_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:
         return Table(list(lt.columns) + right_cols)
     matched = ri >= 0
     rt = gather(right, jnp.maximum(ri, 0))
-    right_cols = []
-    for c in rt.columns:
-        v = matched if c.validity is None else (c.validity & matched)
-        right_cols.append(Column(c.dtype, c.data, c.offsets, v))
-    return Table(list(lt.columns) + right_cols)
+
+    def _with_matched(c):
+        # deferred like the gather itself: the validity AND must not force
+        # columns the plan never reads
+        from ..column import LazyColumn, force_column
+
+        def thunk(c=c):
+            g = force_column(c)
+            v = matched if g.validity is None else (g.validity & matched)
+            return Column(g.dtype, g.data, g.offsets, v, g.children)
+        return LazyColumn(c.dtype, c.num_rows, thunk)
+
+    return Table(list(lt.columns) + [_with_matched(c) for c in rt.columns])
 
 
 def right_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -772,15 +773,24 @@ def convert_to_rows(table: Table,
     _check_row_size(layout, row_sizes)
 
     batches = build_batches(row_sizes, max_batch_bytes)
-    from . import ragged
+    from . import ragged, xpack
     use_dma = ragged.dma_supported()
+    use_xpack = os.environ.get("SRJT_XPACK", "1").lower() not in ("0", "off")
     out = []
     for bi, (lo, hi) in enumerate(zip(batches.row_boundaries[:-1],
                                       batches.row_boundaries[1:])):
         sub = Table([_slice_column(c, lo, hi) for c in table.columns])
-        valid = _table_valid_matrix(sub)
         data = None
-        if use_dma:
+        if use_xpack:
+            # primary engine (round 4): slab-gather + fused-roll program,
+            # one jitted dispatch for the whole batch (see rowconv/xpack.py)
+            col_offs = [hostcache.host_i64(sub[ci].offsets)
+                        for ci in layout.variable_column_indices]
+            data = xpack.to_rows_var_x(
+                layout, sub, batches.row_offsets_within_batch[bi],
+                col_offs)
+        valid = None if data is not None else _table_valid_matrix(sub)
+        if data is None and use_dma:
             data = _to_rows_var_dma(
                 layout, sub, valid, batches.row_offsets_within_batch[bi])
         if data is None:

@@ -130,7 +130,13 @@ def from_rows_np(row_bytes: np.ndarray, row_offsets: np.ndarray,
     lib = _require()
     schema = list(schema)
     layout = compute_row_layout(schema)
-    row_bytes = np.ascontiguousarray(row_bytes, dtype=np.uint8)
+    row_bytes = np.asarray(row_bytes)
+    if row_bytes.dtype != np.uint8:
+        # word-form batches (RowBatch.data may be uint32 — the fixed and
+        # xpack var engines keep the 8-byte-aligned stream as words): the
+        # byte STREAM is wanted, so reinterpret, never value-cast
+        row_bytes = np.ascontiguousarray(row_bytes).view(np.uint8)
+    row_bytes = np.ascontiguousarray(row_bytes)
     row_offsets64 = np.ascontiguousarray(row_offsets, dtype=np.int64)
     n = row_offsets64.shape[0] - 1
     starts = _i32(layout.column_starts)

@@ -1,0 +1,433 @@
+"""Variable-width JCUDF composition as ONE fused XLA program (round 4).
+
+The round-3 string path moved bytes with per-(row|segment) machinery whose
+per-row cost floor was measured at 0.16-0.8 µs (Pallas per-row rolls) or
+24 ns (XLA row-granular gathers) — a 1M-row mixed batch could not beat
+~0.2 GB/s wall.  This module rebuilds the path on the two primitives the
+round-4 chip shootout (``PROFILE_strings.json``, ``tools/probe_slab.py``)
+showed to be fast:
+
+* **slab gathers** — XLA row gathers cost ~24 ns per *gathered row*
+  regardless of row width (43.9 GB/s at 512 B rows), so all gathers here
+  move WIDE slabs covering many logical rows: per-column char windows are
+  gathered per GROUP of ``g`` rows (one slab covers the whole group's
+  chars), and the output packing gathers one ``P``-row slab per 512 B
+  output window.  Gather count is ``n/g + n_windows``, not ``n × pieces``.
+* **log-shift rolls** — per-row dynamic byte placement is a select tree
+  (log₂(width) word passes + a 4-variant byte funnel), pure elementwise,
+  which XLA fuses into a handful of memory passes.  No scatter, no
+  per-element gather, no serialization.
+
+This is the same job as the reference's fused string kernels
+(``copy_strings_to_rows``, row_conversion.cu:827-875, 1861: one launch
+writes fixed slots, validity, and chars for a batch) — restructured so the
+heavy traffic is aligned bulk reads + register shuffles, the TPU-friendly
+shape of that computation.  Everything here is shape-static given the
+geometry buckets, so the whole conversion runs as ONE jitted program per
+(schema, geometry-bucket) with zero host syncs inside.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .layout import RowLayout
+
+LANE = 128
+WIN_W = 128                    # output pack window: 128 u32 words = 512 B
+
+
+def _bucket(x: int, lo: int = 8) -> int:
+    """≤ ~12.5% growth bucket (pow2/8 multiples) to bound jit variants."""
+    if x <= lo:
+        return lo
+    p = lo
+    while p < x:
+        p <<= 1
+    step = max(p // 8, 1)
+    return -(-x // step) * step
+
+
+def _u8_to_u32_rows(b: jnp.ndarray) -> jnp.ndarray:
+    """u8 [n, 4W] → u32 [n, W] little-endian (elementwise, fused)."""
+    n, w4 = b.shape
+    parts = [b[:, k::4].astype(jnp.uint32) for k in range(4)]
+    return (parts[0] | (parts[1] << 8) | (parts[2] << 16)
+            | (parts[3] << 24))
+
+
+def _word_shift_right(m: jnp.ndarray, sh: jnp.ndarray, nbits: int):
+    """Per-row right word-shift (zeros in): out[r, j] = m[r, j - sh[r]].
+
+    Radix-4 select tree: half the passes of the binary tree — the three
+    shifted views per pass are slices of the same buffer, which XLA fuses
+    into one tile read + register selects."""
+    W = m.shape[1]
+    out = m
+    for b in range(0, nbits, 2):
+        s = 1 << b
+        digit = ((sh >> b) & 3).astype(jnp.int32)[:, None]
+        vs = []
+        for k in (1, 2, 3):
+            if k * s >= W:
+                vs.append(jnp.zeros_like(out))
+            else:
+                vs.append(jnp.pad(out, ((0, 0), (k * s, 0)))[:, :W])
+        out = jnp.where(digit == 1, vs[0],
+                        jnp.where(digit == 2, vs[1],
+                                  jnp.where(digit == 3, vs[2], out)))
+    return out
+
+
+def _word_shift_left(m: jnp.ndarray, sh: jnp.ndarray, nbits: int):
+    """Per-row left word-shift (zeros in): out[r, j] = m[r, j + sh[r]]."""
+    W = m.shape[1]
+    out = m
+    for b in range(0, nbits, 2):
+        s = 1 << b
+        digit = ((sh >> b) & 3).astype(jnp.int32)[:, None]
+        vs = []
+        for k in (1, 2, 3):
+            if k * s >= W:
+                vs.append(jnp.zeros_like(out))
+            else:
+                vs.append(jnp.pad(out, ((0, 0), (0, k * s)))[:, k * s:])
+        out = jnp.where(digit == 1, vs[0],
+                        jnp.where(digit == 2, vs[1],
+                                  jnp.where(digit == 3, vs[2], out)))
+    return out
+
+
+def _nbits_for(W: int) -> int:
+    b = 0
+    while (1 << b) < W + 1:
+        b += 1
+    return b
+
+
+def _take_words(m: jnp.ndarray, sh: jnp.ndarray, Wo: int) -> jnp.ndarray:
+    """out[r, j] = m[r, sh[r] + j] for j < Wo (zeros beyond the source).
+
+    NARROWING radix-4 select tree: the level handling digit weight 4^k
+    works at width ``Wo + 4^k − 1`` — widths shrink geometrically, so the
+    total vector traffic is ~(W/3 + Wo·log) per row instead of the naive
+    W·log of a fixed-width tree."""
+    W = m.shape[1]
+    levels = []
+    w = 1
+    while w < W:
+        levels.append(w)
+        w *= 4
+    cur = m
+    for wk in reversed(levels):
+        Wn = Wo + wk - 1
+        digit = ((sh // wk) % 4).astype(jnp.int32)[:, None]
+        vs = []
+        for k in range(4):
+            s0 = k * wk
+            if s0 >= cur.shape[1]:
+                vs.append(jnp.zeros((cur.shape[0], Wn), cur.dtype))
+                continue
+            sl = cur[:, s0:s0 + Wn]
+            if sl.shape[1] < Wn:
+                sl = jnp.pad(sl, ((0, 0), (0, Wn - sl.shape[1])))
+            vs.append(sl)
+        cur = jnp.where(digit == 1, vs[1],
+                        jnp.where(digit == 2, vs[2],
+                                  jnp.where(digit == 3, vs[3], vs[0])))
+    return cur[:, :Wo]
+
+
+def _place_words(m: jnp.ndarray, sh: jnp.ndarray, Wo: int) -> jnp.ndarray:
+    """out[r, sh[r] + j] = m[r, j] (zeros elsewhere), out width Wo.
+
+    WIDENING radix-4 tree (inverse of :func:`_take_words`): digits are
+    applied low→high at geometrically growing widths, so only the final
+    level touches the full output width."""
+    cur = m
+    wk = 1
+    while True:
+        last = wk * 4 >= Wo
+        Wn = Wo if last else min(cur.shape[1] + 3 * wk, Wo)
+        digit = ((sh // wk) % 4).astype(jnp.int32)[:, None]
+        vs = []
+        for k in range(4):
+            keep = max(0, min(cur.shape[1], Wn - k * wk))
+            if keep == 0:
+                vs.append(jnp.zeros((cur.shape[0], Wn), cur.dtype))
+                continue
+            vs.append(jnp.pad(cur[:, :keep],
+                              ((0, 0), (k * wk, Wn - k * wk - keep))))
+        cur = jnp.where(digit == 1, vs[1],
+                        jnp.where(digit == 2, vs[2],
+                                  jnp.where(digit == 3, vs[3], vs[0])))
+        if last:
+            return cur
+        wk *= 4
+
+
+def _byte_shift_right(m: jnp.ndarray, sh_bytes: jnp.ndarray) -> jnp.ndarray:
+    """Per-row right byte-shift of u32 rows in flat little-endian byte
+    order: out byte j = in byte (j - sh) (zeros shifted in)."""
+    W = m.shape[1]
+    wsh = (sh_bytes // 4).astype(jnp.int32)
+    rb = (sh_bytes % 4).astype(jnp.uint32)[:, None]
+    a = _word_shift_right(m, wsh, _nbits_for(W))
+    prev = jnp.pad(a, ((0, 0), (1, 0)))[:, :W]
+    res = a
+    for k in (1, 2, 3):
+        v = (a << jnp.uint32(8 * k)) | (prev >> jnp.uint32(32 - 8 * k))
+        res = jnp.where(rb == k, v, res)
+    return res
+
+
+def _byte_shift_left(m: jnp.ndarray, sh_bytes: jnp.ndarray) -> jnp.ndarray:
+    """Per-row left byte-shift: out byte j = in byte (j + sh)."""
+    W = m.shape[1]
+    wsh = (sh_bytes // 4).astype(jnp.int32)
+    rb = (sh_bytes % 4).astype(jnp.uint32)[:, None]
+    a = _word_shift_left(m, wsh, _nbits_for(W))
+    nxt = jnp.pad(a, ((0, 0), (0, 1)))[:, 1:]
+    res = a
+    for k in (1, 2, 3):
+        v = (a >> jnp.uint32(8 * k)) | (nxt << jnp.uint32(32 - 8 * k))
+        res = jnp.where(rb == k, v, res)
+    return res
+
+
+def _byte_mask(W: int, start_b: jnp.ndarray, end_b: jnp.ndarray):
+    """u32 mask [n, W]: byte positions in [start, end) per row."""
+    pos = (jnp.arange(W, dtype=jnp.int32) * 4)[None, :]
+    s = start_b[:, None]
+    e = end_b[:, None]
+    m = jnp.zeros((start_b.shape[0], W), jnp.uint32)
+    for k in range(4):
+        inside = ((pos + k) >= s) & ((pos + k) < e)
+        m = m | jnp.where(inside, jnp.uint32(0xFF << (8 * k)), jnp.uint32(0))
+    return m
+
+
+def _pad_to_blocks(flat_u8: jnp.ndarray, B: int) -> jnp.ndarray:
+    """u8 [T] → u32 [nb, 2B/4]: B-byte blocks, each row concatenated with
+    its successor so ONE gathered row covers any window of ≤ B bytes."""
+    T = flat_u8.shape[0]
+    nb = max(-(-T // B), 1)
+    pad = nb * B - T
+    b2 = jnp.pad(flat_u8, (0, pad)).reshape(nb, B)
+    w = _u8_to_u32_rows(b2)                      # [nb, B/4]
+    nxt = jnp.concatenate([w[1:], jnp.zeros((1, B // 4), jnp.uint32)])
+    return jnp.concatenate([w, nxt], axis=1)     # [nb, B/2]
+
+
+def extract_group_windows(chars_u8: jnp.ndarray, offs: jnp.ndarray,
+                          n: int, g: int, B: int, Lw: int) -> jnp.ndarray:
+    """Per-row char windows [n, Lw] u32 from a contiguous chars buffer.
+
+    One slab gather per GROUP of ``g`` rows (the group's chars span ≤ B
+    bytes — caller sizes B from the host geometry), then ``g`` fused
+    byte-shifts pull each row's window out of its group slab.
+    """
+    ngroups = -(-n // g)
+    v2 = _pad_to_blocks(chars_u8, B)             # [nb, B/2] u32
+    gstart = offs[jnp.minimum(
+        jnp.arange(ngroups, dtype=jnp.int32) * g, n)]
+    blk = gstart // B
+    slab = v2[jnp.clip(blk, 0, v2.shape[0] - 1)]  # [ngroups, B/2]
+    outs = []
+    for j in range(g):
+        ridx = jnp.minimum(jnp.arange(ngroups, dtype=jnp.int32) * g + j,
+                           n - 1) if n else jnp.zeros(0, jnp.int32)
+        amt = offs[ridx] - blk * B               # byte offset, [0, 2B)
+        w = _take_words(slab, amt // 4, Lw + 1)
+        a, nxt = w[:, :Lw], w[:, 1:Lw + 1]
+        rb = (amt % 4).astype(jnp.uint32)[:, None]
+        rolled = a
+        for k in (1, 2, 3):
+            v = (a >> jnp.uint32(8 * k)) | (nxt << jnp.uint32(32 - 8 * k))
+            rolled = jnp.where(rb == k, v, rolled)
+        outs.append(rolled)
+    out = jnp.stack(outs, axis=1).reshape(ngroups * g, Lw)
+    return out[:n]
+
+
+def _first_row_per_window(dst_w: jnp.ndarray, n: int,
+                          nwin: int) -> jnp.ndarray:
+    """fr[w] = last row r with dst_w[r] ≤ w·WIN_W (rows cover windows
+    contiguously).  Pure segment-sum/cumsum — no searchsorted."""
+    win_of = (dst_w[:n] // WIN_W).astype(jnp.int32)
+    h = jax.ops.segment_sum(jnp.ones(n, jnp.int32), win_of, nwin)
+    lt = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                          jnp.cumsum(h)[:-1]])   # #rows with dst < w·W
+    eq = jax.ops.segment_sum(
+        ((dst_w[:n] % WIN_W) == 0).astype(jnp.int32), win_of, nwin)
+    return lt + eq - 1
+
+
+def pack_windows(dense: jnp.ndarray, dst_w: jnp.ndarray, total_w: int,
+                 P: int, nwin: int) -> jnp.ndarray:
+    """Pack padded rows [n, Mw] into flat words [total_w] (rows are
+    8-byte-aligned so packing is word-granular).
+
+    Output-window-centric: window w takes rows fr(w)..fr(w)+P-1 as ONE
+    gathered slab from a P-wide shifted view of ``dense``, then places each
+    row with a fused word-shift + mask + OR."""
+    n, Mw = dense.shape
+    # P-row slab view: VP[r] = dense[r] ++ dense[r+1] ++ … ++ dense[r+P-1]
+    padded = jnp.pad(dense, ((0, P), (0, 0)))
+    vp = jnp.concatenate([padded[p:n + p] for p in range(P)], axis=1)
+    fr = _first_row_per_window(dst_w, n, nwin)
+    fr = jnp.clip(fr, 0, max(n - 1, 0))
+    slab = vp[fr]                                 # [nwin, P·Mw]
+
+    F = WIN_W + 2 * Mw                            # frame with ±Mw slack
+    wbase = jnp.arange(nwin, dtype=jnp.int32) * WIN_W
+    acc = jnp.zeros((nwin, F), jnp.uint32)
+    for p in range(P):
+        r = jnp.minimum(fr + p, n - 1)
+        d = dst_w[r] - wbase + Mw                 # biased frame offset ≥ 0
+        live = (fr + p < n) & (dst_w[r] < wbase + WIN_W) & (d >= 0)
+        piece = slab[:, p * Mw:(p + 1) * Mw]
+        placed = _place_words(piece, jnp.where(live, d, 0), F)
+        rw = dst_w[r + 1] - dst_w[r]
+        mask = _byte_mask(F, d * 4, (d + rw) * 4)
+        acc = acc | jnp.where(live[:, None], placed & mask, jnp.uint32(0))
+    out = acc[:, Mw:Mw + WIN_W].reshape(-1)
+    return out[:total_w]
+
+
+# ---------------------------------------------------------------------------
+# to_rows: whole-batch fused program
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _to_rows_x_jit(layout: RowLayout, geom, datas, str_offsets, valid):
+    """geom: (n, Mw, P, nwin, total_w, g, per-col (B, Lw)) — all static.
+
+    Everything — including the destination row offsets (the 8-byte-aligned
+    cumsum the host batching derives the same way) — is computed on device:
+    a warm call uploads NOTHING through the tunnel.
+    """
+    n, Mw, P, nwin, total_w, g, colgeo = geom
+    var_idx = layout.variable_column_indices
+    fpv = layout.fixed_plus_validity
+    fpvw = -(-fpv // 4)
+    str_offsets = tuple(o.astype(jnp.int32) for o in str_offsets)
+    # valid: per-column bool [n] or None — the matrix builds in-trace (an
+    # eager stack of 12 validity vectors costs a dispatch each through the
+    # tunnel)
+    vmat = jnp.stack([jnp.ones((n,), jnp.bool_) if v is None else v
+                      for v in valid], axis=1)
+
+    from .convert import _var_fixed_region
+    fixed2d = _var_fixed_region(layout, datas, str_offsets, vmat)
+    fixed_w = _u8_to_u32_rows(
+        jnp.pad(fixed2d, ((0, 0), (0, fpvw * 4 - fpv))))     # [n, fpvw]
+
+    lens = jnp.stack(
+        [str_offsets[vi][1:] - str_offsets[vi][:-1]
+         for vi in range(len(var_idx))], axis=1).astype(jnp.int32)
+    prefix = jnp.cumsum(lens, axis=1) - lens
+
+    dense = jnp.pad(fixed_w, ((0, 0), (0, Mw - fpvw)))
+    for vi in range(len(var_idx)):
+        B, Lw = colgeo[vi]
+        if Lw == 0:
+            continue
+        win = extract_group_windows(datas[var_idx[vi]].reshape(-1),
+                                    str_offsets[vi], n, g, B, Lw)
+        start_b = fpv + prefix[:, vi]
+        # byte funnel at the NARROW width, then the widening word place
+        a = jnp.pad(win, ((0, 0), (0, 1)))
+        prev = jnp.pad(win, ((0, 0), (1, 0)))
+        rb = (start_b % 4).astype(jnp.uint32)[:, None]
+        fun = a
+        for k in (1, 2, 3):
+            v = ((a << jnp.uint32(8 * k))
+                 | (prev >> jnp.uint32(32 - 8 * k)))
+            fun = jnp.where(rb == k, v, fun)
+        placed = _place_words(fun, start_b // 4, Mw)
+        mask = _byte_mask(Mw, start_b, start_b + lens[:, vi])
+        dense = dense | (placed & mask)
+
+    # destination offsets: align8(fpv + Σ lens), cumulative — the same rule
+    # as layout.row_sizes_with_strings (row_conversion.cu:216-261), in words
+    row_b = fpv + prefix[:, -1] + lens[:, -1]
+    rs_w = ((row_b + 7) // 8 * 8) // 4
+    dst_w = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                             jnp.cumsum(rs_w, dtype=jnp.int32)])
+    # (a pair-compaction level before the pack was measured and REJECTED:
+    # the strided row split d[0::2]/d[1::2] alone cost ~76 ms at 1M rows —
+    # more than the whole frame-combine saving it buys)
+    return pack_windows(dense, dst_w, total_w, P, nwin)
+
+
+def _plan_geometry(layout: RowLayout, n: int, offs_np: np.ndarray,
+                   col_offs_np: list[np.ndarray]):
+    """Host geometry pass → static ``geom`` tuple (bucketed), or None when
+    outside the supported buckets."""
+    total = int(offs_np[-1])
+    row_sizes = offs_np[1:] - offs_np[:-1]
+    Mw = _bucket(-(-int(row_sizes.max()) // 4), 8)
+    if Mw > 256:                                  # > 1KB rows: fall back
+        return None
+    nwin = -(-(total // 4) // WIN_W)
+    # max rows overlapping one output window
+    fr = np.searchsorted(offs_np, np.arange(nwin, dtype=np.int64) * 512,
+                         side="right") - 1
+    lr = np.searchsorted(offs_np,
+                         np.minimum(np.arange(nwin, dtype=np.int64) * 512
+                                    + 512, total) - 1, side="right") - 1
+    P = _bucket(int((lr - fr).max(initial=0)) + 1, 2)
+    g = 8
+    colgeo = []
+    for vi in range(len(layout.variable_column_indices)):
+        co = col_offs_np[vi]
+        clens = co[1:] - co[:-1]
+        Lmax = int(clens.max(initial=0))
+        if Lmax == 0:
+            colgeo.append((0, 0))
+            continue
+        idx = np.minimum(np.arange(0, n + g, g), n)
+        span = int((co[idx[1:]] - co[idx[:-1]]).max(initial=1))
+        B = _bucket(max(span, 64), 64)
+        Lw = _bucket(-(-Lmax // 4), 4)
+        if B > (1 << 20) or Lw > 512:
+            return None
+        colgeo.append((B, Lw))
+    return (n, Mw, int(P), nwin, total // 4, g, tuple(colgeo))
+
+
+def to_rows_var_x(layout: RowLayout, sub, offs_np: np.ndarray,
+                  col_offs_np: list[np.ndarray]):
+    """Strings → packed JCUDF rows, one jitted dispatch.
+
+    ``offs_np``: host row offsets [n+1] (8-byte-aligned rows).
+    ``col_offs_np``: host char offsets per var column (geometry buckets).
+    Returns u32 words [total/4] or None when the geometry exceeds the
+    supported buckets (caller falls back).
+
+    The host geometry pass is memoized on the string-offset device arrays
+    (the analytics steady state re-converts the same tables), so a warm
+    call is pure dispatch: no host scans, no device uploads.
+    """
+    n = sub.num_rows
+    var_idx = layout.variable_column_indices
+    if n == 0 or int(offs_np[-1]) == 0:
+        return None
+    from ..utils import syncs
+    key_arrays = [sub[ci].offsets for ci in var_idx]
+    geom = syncs.memo_get("xpack_geom", key_arrays)
+    if geom is None:
+        geom = _plan_geometry(layout, n, offs_np, col_offs_np)
+        if geom is None:
+            return None
+        syncs.memo_put("xpack_geom", key_arrays, geom)
+    return _to_rows_x_jit(
+        layout, geom,
+        tuple(c.data for c in sub.columns),
+        tuple(sub[ci].offsets for ci in var_idx),
+        tuple(c.validity for c in sub.columns))

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Probe the two primitives behind the round-4 string redesign:
+
+(A) slab gather: gathering [n/g, g*W] slabs should cost ~24ns per GATHERED
+    row (flat), i.e. ~24/g ns per logical row;
+(B) per-row log-shift byte roll on [n, W] u32 should fuse to a handful of
+    memory passes.
+
+Usage: python tools/probe_slab.py
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def timeit(name, fn, *args, iters=(3, 13)):
+    run = jax.jit(lambda a: fn(*a))
+
+    @jax.jit
+    def loop(a, it):
+        def step(_, carry):
+            acc, aa = carry
+            d = lax.optimization_barrier((aa, acc))[0]
+            out = fn(*d)
+            out = lax.optimization_barrier(out)
+            probe = lax.convert_element_type(jnp.ravel(out)[0], jnp.int32)
+            return (acc + probe) % jnp.int32(65521), aa
+        acc, _ = lax.fori_loop(0, it, step, (jnp.int32(0), a))
+        return acc
+    np.asarray(loop(args, iters[0]))
+    t0 = time.perf_counter(); np.asarray(loop(args, iters[0]))
+    t_lo = time.perf_counter() - t0
+    t0 = time.perf_counter(); np.asarray(loop(args, iters[1]))
+    t_hi = time.perf_counter() - t0
+    per = (t_hi - t_lo) / (iters[1] - iters[0])
+    print(f"  {name}: {per*1e3:.3f} ms/iter", flush=True)
+    return per
+
+
+def main():
+    print(f"backend: {jax.default_backend()}", flush=True)
+    rng = np.random.default_rng(0)
+
+    # (A) slab gathers at several widths, 128K gathered rows
+    src = jnp.asarray(rng.integers(0, 2**32, 1 << 25, dtype=np.uint32))
+    for W in (16, 64, 160, 384):
+        s2 = src.reshape(-1, W)
+        m = 1 << 17
+        idx = jnp.asarray(np.sort(rng.integers(0, s2.shape[0] - 1, m))
+                          .astype(np.int32))
+        per = timeit(f"slabgather_{W}w_128K", lambda i, s=s2: s[i], idx)
+        print(f"    -> {per/m*1e9:.1f} ns/gathered-row, "
+              f"{m*W*4*2/per/1e9:.1f} GB/s", flush=True)
+
+    # (B) log-shift byte roll on [1M, 40] u32 (per-row dynamic shift)
+    n, W = 1 << 20, 40
+    x = jnp.asarray(rng.integers(0, 2**32, (n, W), dtype=np.uint32))
+    sh = jnp.asarray(rng.integers(0, W * 4, n).astype(np.int32))
+
+    def byte_roll(x, sh):
+        w = sh // 4
+        out = x
+        for b in range(6):                       # log2(64) word passes
+            s = 1 << b
+            shifted = jnp.pad(out, ((0, 0), (s, 0)))[:, :W]
+            bit = ((w >> b) & 1).astype(bool)[:, None]
+            out = jnp.where(bit, shifted, out)
+        prev = jnp.pad(out, ((0, 0), (1, 0)))[:, :W]
+        rb = (sh % 4).astype(jnp.uint32)[:, None]
+        res = out
+        for k in (1, 2, 3):
+            v = (out << jnp.uint32(8 * k)) | (prev >> jnp.uint32(32 - 8 * k))
+            res = jnp.where(rb == k, v, res)
+        return res
+    per = timeit("byteroll_1Mx40w", byte_roll, x, sh)
+    print(f"    -> {n*W*4*2/per/1e9:.1f} GB/s effective", flush=True)
+
+    # (B2) OR-combine of 5 placed rolls (the pack frame combine)
+    nwin, F = 1 << 18, 168
+    slab = jnp.asarray(rng.integers(0, 2**32, (nwin, 200), dtype=np.uint32))
+    offs = jnp.asarray(rng.integers(0, 128, (nwin, 5)).astype(np.int32))
+
+    def frame_combine(slab, offs):
+        acc = jnp.zeros((nwin, F), jnp.uint32)
+        for p in range(5):
+            piece = jnp.pad(slab[:, p * 40:(p + 1) * 40],
+                            ((0, 0), (0, F - 40)))
+            w = offs[:, p]
+            out = piece
+            for b in range(8):
+                s = 1 << b
+                shifted = jnp.pad(out, ((0, 0), (s, 0)))[:, :F]
+                bit = ((w >> b) & 1).astype(bool)[:, None]
+                out = jnp.where(bit, shifted, out)
+            acc = acc | out
+        return acc
+    per = timeit("framecombine_256Kx168w_P5", frame_combine, slab, offs)
+    print(f"    -> {nwin*F*4/per/1e9:.1f} GB/s of output", flush=True)
+
+
+if __name__ == "__main__":
+    main()

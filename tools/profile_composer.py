@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Composer-kernel throughput at the bench string geometry → stdout.
+
+Measures the streaming ragged-composer (rowconv/composer.py) end-to-end and
+kernel-only at the strings_mixed12_1M bench geometry (1M rows, 4 string
+columns, ~125B rows), before wiring it into convert_to_rows.  Also times
+the ragged.unpack direction (fixed-region extraction for from_rows).
+
+Usage: python tools/profile_composer.py [n_rows]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.rowconv import composer, ragged
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    rng = np.random.default_rng(0)
+    print(f"backend: {jax.default_backend()}  n={n}", flush=True)
+
+    # bench-like geometry: fixed+validity region 83B, 4 string cols 0..24B
+    fpv = 83
+    nvar = 4
+    lens = [rng.integers(0, 25, n).astype(np.int64) for _ in range(nvar)]
+    src_offs = []
+    srcs = []
+    fixed_offs = np.arange(n + 1, dtype=np.int64) * fpv
+    src_offs.append(fixed_offs)
+    srcs.append(jnp.asarray(
+        rng.integers(0, 256, n * fpv, dtype=np.uint8)))
+    for v in range(nvar):
+        o = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(lens[v], out=o[1:])
+        src_offs.append(o)
+        srcs.append(jnp.asarray(
+            rng.integers(0, 256, max(int(o[-1]), 1), dtype=np.uint8)))
+
+    row_sizes = fpv + sum(lens)
+    row_sizes = -(-row_sizes // 8) * 8          # 8B-aligned JCUDF rows
+    dst_offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(row_sizes, out=dst_offs[1:])
+    total = int(dst_offs[-1])
+    print(f"total {total/1e6:.1f} MB, avg row {total/n:.1f} B", flush=True)
+
+    all_lens = [fixed_offs[1:] - fixed_offs[:-1]] + lens
+
+    t0 = time.perf_counter()
+    plan = composer.plan_compose(src_offs, dst_offs,
+                                 [int(s.shape[0]) for s in srcs])
+    t_plan = time.perf_counter() - t0
+    print(f"plan: {t_plan*1e3:.1f} ms  RB={plan.RB} nblocks={plan.nblocks} "
+          f"rsb={plan.rsb} win_rows={plan.win_rows} cap={plan.cap_rows}",
+          flush=True)
+
+    t0 = time.perf_counter()
+    wb_np = composer.plan_prefetch(plan, src_offs)
+    wb = [jnp.asarray(w) for w in wb_np]
+    mb_np = (np.minimum(np.arange(plan.nblocks, dtype=np.int64) * plan.RB, n)
+             * plan.S // composer.LANE).astype(np.int32)
+    mb = jnp.asarray(mb_np)
+    meta = composer.build_meta(
+        plan, [jnp.asarray(o) for o in src_offs],
+        [jnp.asarray(l) for l in all_lens], jnp.asarray(dst_offs))
+    meta.block_until_ready()
+    t_meta = time.perf_counter() - t0
+    print(f"prefetch+meta: {t_meta*1e3:.1f} ms", flush=True)
+
+    t0 = time.perf_counter()
+    out = composer.compose(plan, wb, mb, meta, srcs)
+    np.asarray(out[:2])
+    t_cold = time.perf_counter() - t0
+    print(f"compose cold: {t_cold:.2f} s", flush=True)
+
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = composer.compose(plan, wb, mb, meta, srcs)
+        np.asarray(out[:2])
+        t_warm = time.perf_counter() - t0
+        print(f"compose warm: {t_warm*1e3:.1f} ms  "
+              f"{total/t_warm/1e9:.2f} GB/s", flush=True)
+
+    # correctness spot check vs the XLA oracle on a small prefix
+    ns = 4096
+    sub_offs = [o[:ns + 1] for o in src_offs]
+    sub_lens = [l[:ns] for l in all_lens]
+    sub_dst = dst_offs[:ns + 1]
+    ref = composer.compose_xla(sub_offs, sub_lens, sub_dst, srcs,
+                               int(sub_dst[-1]))
+    pad = (-out.shape[0]) % 128
+    got = np.asarray(ragged.u32_to_u8(
+        jnp.pad(out, (0, pad))))[:int(sub_dst[-1])]
+    ok = bool((np.asarray(ref) == got).all())
+    print(f"prefix byte-exact vs XLA oracle: {ok}", flush=True)
+
+    # unpack direction (fixed-region extraction at from_rows geometry)
+    flat_u8 = ragged.u32_to_u8(jnp.pad(out, (0, (-out.shape[0]) % 128)))
+    t0 = time.perf_counter()
+    dense = ragged.unpack(flat_u8, dst_offs, fpv)
+    np.asarray(dense[:1, :1])
+    t_cold = time.perf_counter() - t0
+    print(f"unpack(fpv={fpv}) cold: {t_cold:.2f} s", flush=True)
+    for _ in range(2):
+        t0 = time.perf_counter()
+        dense = ragged.unpack(flat_u8, dst_offs, fpv)
+        np.asarray(dense[:1, :1])
+        t_warm = time.perf_counter() - t0
+        print(f"unpack warm: {t_warm*1e3:.1f} ms  "
+              f"{n*fpv/t_warm/1e9:.2f} GB/s (dense bytes)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
