@@ -73,7 +73,15 @@ def _parse_acq(acq: Table) -> Table:
 
 def etl(files: dict[str, bytes]) -> Table:
     """Full pipeline → feature table (FEATURE_COLS order, sorted by loan)."""
-    tables = load_tables(files)
+    return etl_tables(load_tables(files))
+
+
+def etl_tables(tables: dict[str, Table]) -> Table:
+    """The decode-free plan over loaded tables — separable so the whole
+    string-parse/aggregate/join pipeline compiles to ONE program through
+    ``models.compiled.compile_query`` (the per-loan parse syncs that made
+    the eager pipeline ~300 s at toy scale collapse into the capture
+    tape)."""
     perf = _parse_perf(tables["perf"])
     acq = _parse_acq(tables["acq"])
 

@@ -112,6 +112,8 @@ def _bind(lib: ctypes.CDLL) -> None:
     # snappy (native/snappy_native.cpp)
     _sig(lib, "srjt_snappy_decompress", _c.c_long,
          [_c.c_char_p, _c.c_long, _c.c_char_p, _c.c_long])
+    _sig(lib, "srjt_byte_array_offsets", _c.c_long,
+         [_c.c_char_p, _c.c_long, _c.c_long, vp])
 
 
 def load() -> Optional[ctypes.CDLL]:

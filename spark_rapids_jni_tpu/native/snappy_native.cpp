@@ -86,4 +86,29 @@ long srjt_snappy_decompress(const unsigned char* src, long src_len,
   return (op == dst_len) ? op : -1;
 }
 
+// PLAIN BYTE_ARRAY page walk: the (4-byte LE length, bytes)* stream's
+// offsets are an inherently sequential recurrence (offset[i+1] depends on
+// length[offset[i]]), so the walk runs native — the role libcudf's string
+// decode plays for the reference (SURVEY §2.9).  Writes n+1 int32 Arrow
+// offsets (char positions, length prefixes excluded) and returns the char
+// total, or -1 on truncation/overflow.
+long srjt_byte_array_offsets(const unsigned char* payload, long size,
+                             long n, int32_t* out_offs) {
+  long pos = 0;
+  long total = 0;
+  out_offs[0] = 0;
+  for (long i = 0; i < n; ++i) {
+    if (pos + 4 > size) return -1;
+    uint32_t len;
+    std::memcpy(&len, payload + pos, 4);   // little-endian host assumed
+    pos += 4;
+    if (len > static_cast<uint64_t>(size - pos)) return -1;
+    pos += len;
+    total += len;
+    if (total > INT32_MAX) return -1;
+    out_offs[i + 1] = static_cast<int32_t>(total);
+  }
+  return total;
+}
+
 }  // extern "C"

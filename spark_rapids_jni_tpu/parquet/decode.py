@@ -328,7 +328,18 @@ def _decode_plain(data: bytes, phys: int, n: int, type_len: int = 0):
         chars = np.frombuffer(data, np.uint8, n * type_len).copy()
         return chars, np.full(n, type_len, dtype=np.int32)
     if phys == PT_BYTE_ARRAY:
-        # length-prefixed strings — vectorized walk of the length prefixes
+        offs = byte_array_offsets(data, n)
+        if offs is not None:
+            # native walk, then ONE vectorized gather strips the 4-byte
+            # prefixes: char k belongs to row_of(k) and sits 4*(row+1)
+            # prefix bytes past its packed position
+            lengths = offs[1:] - offs[:-1]
+            arr = np.frombuffer(data, dtype=np.uint8)
+            total = int(offs[-1])
+            row_of = np.repeat(np.arange(n, dtype=np.int64), lengths)
+            chars = arr[np.arange(total, dtype=np.int64) + 4 * (row_of + 1)]
+            return chars, lengths
+        # pure-python fallback (no native lib): walk the prefixes
         lengths = np.empty(n, dtype=np.int32)
         starts = np.empty(n, dtype=np.int64)
         pos = 0
@@ -348,6 +359,25 @@ def _decode_plain(data: bytes, phys: int, n: int, type_len: int = 0):
             cursor += lengths[i]
         return chars, lengths
     raise NotImplementedError(f"unsupported physical type {phys}")
+
+
+def byte_array_offsets(data: bytes, n: int) -> "np.ndarray | None":
+    """Arrow char offsets [n+1] of a PLAIN BYTE_ARRAY payload via the
+    native walker (the offsets recurrence is sequential — C-rate, not
+    Python-rate); None when the native lib is absent or input malformed."""
+    from .. import native as _native
+    lib = _native.load()
+    if lib is None:
+        return None
+    try:
+        fn = lib.srjt_byte_array_offsets
+    except AttributeError:
+        return None
+    offs = np.empty(n + 1, dtype=np.int32)
+    rc = fn(data, len(data), n, offs.ctypes.data)
+    if rc < 0:
+        return None
+    return offs
 
 
 class _PageStream:

@@ -898,6 +898,7 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
                     bdata, row_base_np + s[:, 0], offs[:-1], lens,
                     int(offs[-1])))
         else:
+            from . import xpack
             row_base = batch.offsets[:-1].astype(jnp.int64)
             row_sizes = (batch.offsets[1:]
                          - batch.offsets[:-1]).astype(jnp.int64)
@@ -910,17 +911,30 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
                              | (s[:, 0].astype(jnp.int64)
                                 + s[:, 1] > row_sizes))
                             .astype(jnp.int32)) for s in slots]
-            # one stacked tiny sync: totals + violation counts
+            src_starts = [row_base + s[:, 0].astype(jnp.int64)
+                          for s in slots]
+            # one stacked tiny sync: totals + violation counts + the
+            # segmented-gather geometry stats (device-computed maxima)
             meta = np.asarray(jnp.stack(
-                [jnp.stack([o[-1], v.astype(jnp.int64)])
-                 for o, v in zip(out_offsets, viol)]))
+                [jnp.concatenate([
+                    jnp.stack([o[-1], v.astype(jnp.int64)]),
+                    xpack._seg_gather_stats(st, s[:, 1], o)])
+                 for o, v, st, s in zip(out_offsets, viol, src_starts,
+                                        slots)]))
             if meta[:, 1].any():
                 raise ValueError(
                     "corrupt row data: string slot outside its row")
             for vi in range(nvar):
-                chars.append(_gather_chars(
-                    int(meta[vi, 0]), bdata, row_base, slots[vi],
-                    out_offsets[vi]))
+                geom = xpack.plan_from_device_stats(meta[vi, 2:], n)
+                if geom is not None:
+                    # segmented gather: slab/roll engine, ONE program
+                    chars.append(xpack.segmented_gather(
+                        geom, bdata, src_starts[vi].astype(jnp.int32),
+                        slots[vi][:, 1], out_offsets[vi]))
+                else:
+                    chars.append(_gather_chars(
+                        int(meta[vi, 0]), bdata, row_base, slots[vi],
+                        out_offsets[vi]))
         return _assemble(schema, datas, valid, tuple(chars),
                          [o.astype(jnp.int32) for o in out_offsets])
 

@@ -60,48 +60,6 @@ def _u8_to_u32_rows(b: jnp.ndarray) -> jnp.ndarray:
             | (parts[3] << 24))
 
 
-def _word_shift_right(m: jnp.ndarray, sh: jnp.ndarray, nbits: int):
-    """Per-row right word-shift (zeros in): out[r, j] = m[r, j - sh[r]].
-
-    Radix-4 select tree: half the passes of the binary tree — the three
-    shifted views per pass are slices of the same buffer, which XLA fuses
-    into one tile read + register selects."""
-    W = m.shape[1]
-    out = m
-    for b in range(0, nbits, 2):
-        s = 1 << b
-        digit = ((sh >> b) & 3).astype(jnp.int32)[:, None]
-        vs = []
-        for k in (1, 2, 3):
-            if k * s >= W:
-                vs.append(jnp.zeros_like(out))
-            else:
-                vs.append(jnp.pad(out, ((0, 0), (k * s, 0)))[:, :W])
-        out = jnp.where(digit == 1, vs[0],
-                        jnp.where(digit == 2, vs[1],
-                                  jnp.where(digit == 3, vs[2], out)))
-    return out
-
-
-def _word_shift_left(m: jnp.ndarray, sh: jnp.ndarray, nbits: int):
-    """Per-row left word-shift (zeros in): out[r, j] = m[r, j + sh[r]]."""
-    W = m.shape[1]
-    out = m
-    for b in range(0, nbits, 2):
-        s = 1 << b
-        digit = ((sh >> b) & 3).astype(jnp.int32)[:, None]
-        vs = []
-        for k in (1, 2, 3):
-            if k * s >= W:
-                vs.append(jnp.zeros_like(out))
-            else:
-                vs.append(jnp.pad(out, ((0, 0), (0, k * s)))[:, k * s:])
-        out = jnp.where(digit == 1, vs[0],
-                        jnp.where(digit == 2, vs[1],
-                                  jnp.where(digit == 3, vs[2], out)))
-    return out
-
-
 def _nbits_for(W: int) -> int:
     b = 0
     while (1 << b) < W + 1:
@@ -170,35 +128,6 @@ def _place_words(m: jnp.ndarray, sh: jnp.ndarray, Wo: int) -> jnp.ndarray:
         wk *= 4
 
 
-def _byte_shift_right(m: jnp.ndarray, sh_bytes: jnp.ndarray) -> jnp.ndarray:
-    """Per-row right byte-shift of u32 rows in flat little-endian byte
-    order: out byte j = in byte (j - sh) (zeros shifted in)."""
-    W = m.shape[1]
-    wsh = (sh_bytes // 4).astype(jnp.int32)
-    rb = (sh_bytes % 4).astype(jnp.uint32)[:, None]
-    a = _word_shift_right(m, wsh, _nbits_for(W))
-    prev = jnp.pad(a, ((0, 0), (1, 0)))[:, :W]
-    res = a
-    for k in (1, 2, 3):
-        v = (a << jnp.uint32(8 * k)) | (prev >> jnp.uint32(32 - 8 * k))
-        res = jnp.where(rb == k, v, res)
-    return res
-
-
-def _byte_shift_left(m: jnp.ndarray, sh_bytes: jnp.ndarray) -> jnp.ndarray:
-    """Per-row left byte-shift: out byte j = in byte (j + sh)."""
-    W = m.shape[1]
-    wsh = (sh_bytes // 4).astype(jnp.int32)
-    rb = (sh_bytes % 4).astype(jnp.uint32)[:, None]
-    a = _word_shift_left(m, wsh, _nbits_for(W))
-    nxt = jnp.pad(a, ((0, 0), (0, 1)))[:, 1:]
-    res = a
-    for k in (1, 2, 3):
-        v = (a >> jnp.uint32(8 * k)) | (nxt << jnp.uint32(32 - 8 * k))
-        res = jnp.where(rb == k, v, res)
-    return res
-
-
 def _byte_mask(W: int, start_b: jnp.ndarray, end_b: jnp.ndarray):
     """u32 mask [n, W]: byte positions in [start, end) per row."""
     pos = (jnp.arange(W, dtype=jnp.int32) * 4)[None, :]
@@ -254,16 +183,17 @@ def extract_group_windows(chars_u8: jnp.ndarray, offs: jnp.ndarray,
     return out[:n]
 
 
-def _first_row_per_window(dst_w: jnp.ndarray, n: int,
-                          nwin: int) -> jnp.ndarray:
-    """fr[w] = last row r with dst_w[r] ≤ w·WIN_W (rows cover windows
-    contiguously).  Pure segment-sum/cumsum — no searchsorted."""
-    win_of = (dst_w[:n] // WIN_W).astype(jnp.int32)
+def _first_row_per_window(dst: jnp.ndarray, n: int, nwin: int,
+                          win: int = WIN_W) -> jnp.ndarray:
+    """fr[w] = last row r with dst[r] ≤ w·win (rows cover windows
+    contiguously; ``dst``/``win`` share a unit — words or bytes).  Pure
+    segment-sum/cumsum — no searchsorted."""
+    win_of = (dst[:n] // win).astype(jnp.int32)
     h = jax.ops.segment_sum(jnp.ones(n, jnp.int32), win_of, nwin)
     lt = jnp.concatenate([jnp.zeros(1, jnp.int32),
-                          jnp.cumsum(h)[:-1]])   # #rows with dst < w·W
+                          jnp.cumsum(h)[:-1]])   # #rows with dst < w·win
     eq = jax.ops.segment_sum(
-        ((dst_w[:n] % WIN_W) == 0).astype(jnp.int32), win_of, nwin)
+        ((dst[:n] % win) == 0).astype(jnp.int32), win_of, nwin)
     return lt + eq - 1
 
 
@@ -297,6 +227,173 @@ def pack_windows(dense: jnp.ndarray, dst_w: jnp.ndarray, total_w: int,
         acc = acc | jnp.where(live[:, None], placed & mask, jnp.uint32(0))
     out = acc[:, Mw:Mw + WIN_W].reshape(-1)
     return out[:total_w]
+
+
+def _byte_funnel_right(win: jnp.ndarray, rb: jnp.ndarray) -> jnp.ndarray:
+    """[n, W] u32 → [n, W+1]: shift each row RIGHT by rb∈[0,4) bytes."""
+    a = jnp.pad(win, ((0, 0), (0, 1)))
+    prev = jnp.pad(win, ((0, 0), (1, 0)))
+    rbc = rb.astype(jnp.uint32)[:, None]
+    fun = a
+    for k in (1, 2, 3):
+        v = (a << jnp.uint32(8 * k)) | (prev >> jnp.uint32(32 - 8 * k))
+        fun = jnp.where(rbc == k, v, fun)
+    return fun
+
+
+def _words_to_u8(w: jnp.ndarray) -> jnp.ndarray:
+    """u32 [N] → u8 [4N] little-endian (elementwise)."""
+    pad = (-w.shape[0]) % LANE
+    w2 = jnp.pad(w, (0, pad)).reshape(-1, LANE)
+    out = jnp.zeros((w2.shape[0], 4 * LANE), jnp.uint8)
+    for k in range(4):
+        out = out.at[:, k::4].set(((w2 >> (8 * k)) & 0xFF).astype(jnp.uint8))
+    return out.reshape(-1)[:w.shape[0] * 4]
+
+
+# ---------------------------------------------------------------------------
+# segmented gather: ordered byte segments → packed stream (device)
+# ---------------------------------------------------------------------------
+
+def plan_segmented_gather(src_starts_np: np.ndarray, lens_np: np.ndarray,
+                          dst_offs_np: np.ndarray):
+    """Host geometry for :func:`segmented_gather` (bucketed statics), or
+    None outside the supported buckets.  Segments must be ordered in the
+    source (monotone starts) — true for parquet string payloads and JCUDF
+    row streams alike."""
+    n = int(lens_np.shape[0])
+    total = int(dst_offs_np[-1])
+    if n == 0 or total == 0:
+        return None
+    g = 8
+    Lmax = int(lens_np.max(initial=0))
+    Lw = _bucket(-(-max(Lmax, 1) // 4) + 1, 4)
+    idx = np.minimum(np.arange(0, n + g, g), n)
+    ends = src_starts_np + lens_np
+    lo, hi = idx[:-1], idx[1:]
+    nonempty = hi > lo
+    span = int((ends[np.maximum(hi - 1, 0)] - src_starts_np[lo])
+               [nonempty].max(initial=0))
+    B = _bucket(max(span, 64), 64)
+    gd = dst_offs_np[idx]
+    Bd = _bucket(-(-int((gd[1:] - gd[:-1]).max(initial=1)) // 4) + 1, 8)
+    nwin = -(-total // 512)
+    fr = np.searchsorted(gd, np.arange(nwin, dtype=np.int64) * 512,
+                         side="right") - 1
+    lr = np.searchsorted(gd, np.minimum(
+        np.arange(nwin, dtype=np.int64) * 512 + 512, total) - 1,
+        side="right") - 1
+    P = _bucket(int((lr - fr).max(initial=0)) + 1, 2)
+    # the same caps as plan_from_device_stats: short-segment geometries
+    # (P explodes with ~64 groups per window) must degrade to the caller's
+    # fallback, not compile a P-times-unrolled combine
+    if B > (1 << 20) or Lw > 512 or Bd > 512 or P > 64:
+        return None
+    return (n, g, B, Lw, Bd, int(P), nwin, total)
+
+
+@jax.jit
+def _seg_gather_stats(src_starts, lens, dst_offs):
+    """Device geometry stats for :func:`plan_from_device_stats`: ONE tiny
+    stacked sync instead of pulling per-segment metadata to the host
+    (g = 8).  Returns [total, Lmax, max group src span, max group dst
+    span, max groups overlapping a 512B output window]."""
+    g = 8
+    n = lens.shape[0]
+    src_starts = src_starts.astype(jnp.int64)
+    lens = lens.astype(jnp.int64)
+    dst_offs = dst_offs.astype(jnp.int64)
+    ngroups = -(-n // g)
+    gi = jnp.minimum(jnp.arange(ngroups + 1) * g, n)
+    ends = src_starts + lens
+    gstart = src_starts[jnp.minimum(gi[:-1], n - 1)]
+    gend = ends[jnp.minimum(gi[1:] - 1, n - 1)]
+    src_span = jnp.max(gend - gstart)
+    dstg = dst_offs[gi]
+    dspan = jnp.max(dstg[1:] - dstg[:-1])
+    total = dst_offs[-1]
+    # max groups overlapping any 512B output window: for each group k,
+    # how many group starts fall inside [dstg[k], dstg[k] + 512)
+    upto = jnp.searchsorted(dstg[:-1], dstg[:-1] + 512, side="left")
+    max_p = jnp.max(upto - jnp.arange(ngroups)) + 1
+    return jnp.stack([total, jnp.max(lens), src_span, dspan, max_p])
+
+
+def plan_from_device_stats(stats, n: int):
+    """:func:`segmented_gather` geom from the device-stats sync."""
+    total, Lmax, src_span, dspan, max_p = (int(x) for x in stats)
+    if n == 0 or total == 0:
+        return None
+    g = 8
+    Lw = _bucket(-(-max(Lmax, 1) // 4) + 1, 4)
+    B = _bucket(max(src_span, 64), 64)
+    Bd = _bucket(-(-max(dspan, 1) // 4) + 1, 8)
+    P = _bucket(max_p, 2)
+    if B > (1 << 20) or Lw > 512 or Bd > 512 or P > 64:
+        return None
+    nwin = -(-total // 512)
+    return (n, g, B, Lw, Bd, int(P), nwin, total)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def segmented_gather(geom, src_u8: jnp.ndarray, src_starts: jnp.ndarray,
+                     lens: jnp.ndarray, dst_offs: jnp.ndarray):
+    """Pack ordered byte segments: out[dst_offs[i]:dst_offs[i]+lens[i]] =
+    src[src_starts[i]:+lens[i]], fully on device — group-slab gathers and
+    narrow/widening roll trees (same primitives as the to_rows engine).
+    Returns u8 [total]."""
+    n, g, B, Lw, Bd, P, nwin, total = geom
+    src_starts = src_starts.astype(jnp.int32)
+    lens = lens.astype(jnp.int32)
+    dst_offs = dst_offs.astype(jnp.int32)
+    ngroups = -(-n // g)
+    v2 = _pad_to_blocks(src_u8, B)
+    gidx = jnp.minimum(jnp.arange(ngroups, dtype=jnp.int32) * g, n - 1)
+    gsrc0 = src_starts[gidx]
+    blk = gsrc0 // B
+    slab = v2[jnp.clip(blk, 0, v2.shape[0] - 1)]
+    dstg = dst_offs[jnp.minimum(
+        jnp.arange(ngroups + 1, dtype=jnp.int32) * g, n)]
+    acc = jnp.zeros((ngroups, Bd), jnp.uint32)
+    for j in range(g):
+        ridx = jnp.minimum(jnp.arange(ngroups, dtype=jnp.int32) * g + j,
+                           n - 1)
+        live = (jnp.arange(ngroups, dtype=jnp.int32) * g + j) < n
+        amt = src_starts[ridx] - blk * B
+        w = _take_words(slab, amt // 4, Lw + 1)
+        a, nxt = w[:, :Lw], w[:, 1:Lw + 1]
+        rb = (amt % 4).astype(jnp.uint32)[:, None]
+        piece = a
+        for k in (1, 2, 3):
+            v = (a >> jnp.uint32(8 * k)) | (nxt << jnp.uint32(32 - 8 * k))
+            piece = jnp.where(rb == k, v, piece)
+        drel = dst_offs[ridx] - dstg[:-1]
+        fun = _byte_funnel_right(piece, drel % 4)
+        placed = _place_words(fun, drel // 4, Bd)
+        mask = _byte_mask(Bd, drel, drel + lens[ridx])
+        acc = acc | jnp.where(live[:, None], placed & mask, jnp.uint32(0))
+
+    # window combine (byte-granular group destinations)
+    fr = _first_row_per_window(dstg, ngroups, nwin, 512)
+    fr = jnp.clip(fr, 0, ngroups - 1)
+    padded = jnp.pad(acc, ((0, P), (0, 0)))
+    vp = jnp.concatenate([padded[p:ngroups + p] for p in range(P)], axis=1)
+    slab2 = vp[fr]
+    F = WIN_W + 2 * Bd
+    wbase = jnp.arange(nwin, dtype=jnp.int32) * 512
+    out = jnp.zeros((nwin, F), jnp.uint32)
+    for p in range(P):
+        r = jnp.minimum(fr + p, ngroups - 1)
+        d_b = dstg[r] - wbase + Bd * 4            # biased, ≥ 0 when live
+        live = (fr + p < ngroups) & (dstg[r] < wbase + 512) & (d_b >= 0)
+        piece = slab2[:, p * Bd:(p + 1) * Bd]
+        fun = _byte_funnel_right(piece, d_b % 4)
+        placed = _place_words(fun, d_b // 4, F)
+        glen = dstg[r + 1] - dstg[r]
+        mask = _byte_mask(F, d_b, d_b + glen)
+        out = out | jnp.where(live[:, None], placed & mask, jnp.uint32(0))
+    flat = out[:, Bd:Bd + WIN_W].reshape(-1)
+    return _words_to_u8(flat)[:total]
 
 
 # ---------------------------------------------------------------------------
@@ -420,12 +517,16 @@ def to_rows_var_x(layout: RowLayout, sub, offs_np: np.ndarray,
         return None
     from ..utils import syncs
     key_arrays = [sub[ci].offsets for ci in var_idx]
-    geom = syncs.memo_get("xpack_geom", key_arrays)
+    # the geometry depends on the LAYOUT too (fpv feeds the row sizes), so
+    # the memo tag carries it — the same string column objects reused under
+    # a different schema must not hit a stale geometry
+    tag = f"xpack_geom:{hash(layout)}"
+    geom = syncs.memo_get(tag, key_arrays)
     if geom is None:
         geom = _plan_geometry(layout, n, offs_np, col_offs_np)
         if geom is None:
             return None
-        syncs.memo_put("xpack_geom", key_arrays, geom)
+        syncs.memo_put(tag, key_arrays, geom)
     return _to_rows_x_jit(
         layout, geom,
         tuple(c.data for c in sub.columns),

@@ -182,3 +182,50 @@ def test_non_decimal_flba_falls_back():
                   "x": pa.array(np.arange(n, dtype=np.int64))})
     raw = write(t, use_dictionary=False)
     assert_tables_match(device_scan.scan_table(raw), decode.read_table(raw))
+
+
+@pytest.mark.parametrize("compression", ["NONE", "SNAPPY"])
+def test_plain_strings_on_device(compression):
+    """VERDICT r3 #2 done-criterion: a string column decoded ON DEVICE —
+    scan_column_device must handle the PLAIN string chunk itself (no host
+    fallback) and match the host decoder byte-exactly."""
+    words = ["", "tpu", "spark-rapids", "columnar row transcode",
+             "x" * 40, "payload"]
+    n = 4000
+    strs = [words[i % len(words)] if i % 11 else None for i in range(n)]
+    t = pa.table({
+        "s": pa.array(strs),
+        "v": pa.array(RNG.integers(0, 1 << 30, n).astype(np.int64)),
+    })
+    raw = write(t, compression=compression, use_dictionary=False)
+    dev = device_scan.scan_table(raw)
+    host = decode.read_table(raw)
+    assert_tables_match(dev, host)
+    offs_d = np.asarray(dev.columns[0].offsets)
+    offs_h = np.asarray(host.columns[0].offsets)
+    np.testing.assert_array_equal(offs_d, offs_h)
+
+
+def test_plain_booleans_on_device():
+    n = 3000
+    vals = RNG.integers(0, 2, n).astype(bool)
+    mask = RNG.random(n) < 0.1
+    t = pa.table({"b": pa.array(vals, mask=mask),
+                  "k": pa.array(np.arange(n, dtype=np.int32))})
+    raw = write(t, use_dictionary=False)
+    assert_tables_match(device_scan.scan_table(raw),
+                        decode.read_table(raw))
+
+
+def test_device_scan_strings_not_fallback(monkeypatch):
+    """Prove the string column goes through the DEVICE path: poison the
+    host per-column decoder and scan anyway."""
+    n = 2048
+    t = pa.table({"s": pa.array([f"name-{i % 97}" for i in range(n)])})
+    raw = write(t, use_dictionary=False)
+
+    def boom(*a, **k):
+        raise AssertionError("host column decode reached")
+    monkeypatch.setattr(device_scan.D, "read_table", boom)
+    dev = device_scan.scan_table(raw)
+    assert dev.columns[0].to_pylist()[:3] == ["name-0", "name-1", "name-2"]

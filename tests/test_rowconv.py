@@ -217,3 +217,29 @@ def test_fixed_batches_are_u32_words():
     back = convert_from_rows(RowBatch(b.device_u8(), b.offsets), t.schema)
     for a, c in zip(back.columns, t.columns):
         np.testing.assert_array_equal(np.asarray(a.data), np.asarray(c.data))
+
+
+def test_xpack_geometry_not_reused_across_layouts():
+    """Round-4 regression: the xpack geometry memo is keyed on the string
+    column's offsets arrays — REUSING the same string Column under a
+    different fixed-width layout (different fpv → different row sizes)
+    must re-plan, not hit a stale geometry and emit corrupt rows."""
+    import os
+    rng = np.random.default_rng(5)
+    n = 3000
+    strs = [("v" * int(k)) if k else "" for k in rng.integers(0, 9, n)]
+    str_col = Column.strings_from_list(strs)
+    t1 = Table([Column.from_numpy(
+        rng.integers(0, 100, n, dtype=np.int32)), str_col])
+    t2 = Table([Column.from_numpy(
+        rng.integers(0, 100, n, dtype=np.int64)), str_col,
+        Column.from_numpy(rng.integers(0, 2, n).astype(np.uint8),
+                          sr.bool8)])
+    for t in (t1, t2):
+        got = convert_to_rows(t)[0].host_bytes()
+        os.environ["SRJT_XPACK"] = "0"
+        try:
+            want = convert_to_rows(t)[0].host_bytes()
+        finally:
+            os.environ["SRJT_XPACK"] = "1"
+        np.testing.assert_array_equal(got, want)

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Mortgage ETL timing (BASELINE config #5) → MORTGAGE_BENCH.json.
+
+Round-3 state: the eager pipeline spent ~300 s producing a (300, 9)
+feature matrix — per-loan string-parse syncs and eager dispatches through
+the tunnel.  Round 4 compiles the whole decode-free plan
+(``models.mortgage.etl_tables``) into ONE program via the capture/replay
+machinery (``models/compiled.py``), so the steady state is a single
+dispatch.  Reported:
+
+  decode_s   — parquet → device tables (host staging + upload)
+  cold_s     — eager capture run (records the sync tape) + fused compile
+  warm_s     — one-dispatch re-execution, wall incl. result pull
+  steady_ms  — trip-count-differenced in-jit time per execution
+
+Usage: python tools/mortgage_bench.py [n_loans] [out.json]
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", ".jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+
+def main():
+    n_loans = int(sys.argv[1]) if len(sys.argv) > 1 else 300
+    out_path = sys.argv[2] if len(sys.argv) > 2 else "MORTGAGE_BENCH.json"
+    print(f"backend: {jax.default_backend()}  n_loans: {n_loans}",
+          flush=True)
+
+    from benchmarks import mortgage_data
+    from spark_rapids_jni_tpu.models import mortgage
+    from spark_rapids_jni_tpu.models.compiled import compile_query
+    from spark_rapids_jni_tpu.utils import syncs
+    from tools.query_bench import steady_per_iter
+
+    files = mortgage_data.generate(n_loans=n_loans, seed=11)
+    res = {"n_loans": n_loans}
+
+    t0 = time.perf_counter()
+    tables = mortgage.load_tables(files)
+    for t in tables.values():
+        for c in t.columns:
+            np.asarray(c.data[:1])
+    res["decode_s"] = round(time.perf_counter() - t0, 2)
+    print(f"decode: {res['decode_s']}s", flush=True)
+
+    syncs.reset_sync_count()
+    t0 = time.perf_counter()
+    cq = compile_query(mortgage.etl_tables, tables)
+    jax.block_until_ready([c.data for c in cq.expected.columns])
+    np.asarray(cq.expected[0].data[:1])
+    res["cold_s"] = round(time.perf_counter() - t0, 2)
+    res["cold_syncs"] = syncs.reset_sync_count()
+    print(f"cold: {res['cold_s']}s  syncs={res['cold_syncs']}", flush=True)
+
+    out = cq.run(tables)                    # compile the fused program
+    np.asarray(out[0].data[:1])
+    syncs.reset_sync_count()
+    t0 = time.perf_counter()
+    out = cq.run(tables)
+    jax.block_until_ready([c.data for c in out.columns])
+    np.asarray(out[0].data[:1])
+    res["warm_s"] = round(time.perf_counter() - t0, 3)
+    res["warm_syncs"] = syncs.reset_sync_count()
+    res["rows_out"] = out.num_rows
+    print(f"warm: {res['warm_s']}s  syncs={res['warm_syncs']}  "
+          f"rows={res['rows_out']}", flush=True)
+
+    per = steady_per_iter(cq._prog, tables)
+    res["steady_ms"] = round(per * 1e3, 1) if per is not None else None
+    print(f"steady: {res['steady_ms']} ms", flush=True)
+
+    with open(out_path, "w") as f:
+        json.dump(res, f, indent=1)
+    print("wrote", out_path, flush=True)
+
+
+if __name__ == "__main__":
+    main()
