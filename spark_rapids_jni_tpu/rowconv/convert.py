@@ -297,23 +297,28 @@ def _to_rows_fixed_words(layout: RowLayout, datas: tuple[jnp.ndarray, ...],
     return flat[:n * W] if n_pad != n else flat
 
 
-@functools.partial(jax.jit, static_argnums=0)
-def _from_rows_fixed_words(layout: RowLayout, flat: jnp.ndarray):
-    """Flat u32 row words [n*W] → (datas tuple, valid bool [n, ncols])."""
-    W = layout.fixed_row_size // 4
-    n = flat.shape[0] // W
-    n_pad = -(-n // 128) * 128
-    if n_pad != n:
-        flat = jnp.pad(flat, (0, (n_pad - n) * W))
-    t2 = _deinterleave_words(flat, W)                    # [W, n_pad]
+def _decode_row_words(layout: RowLayout, word, n: int):
+    """Shared word-level row decoder.
 
-    def word(w):
-        return t2[w]
-
+    ``word(w)`` returns the u32 vector (length ≥ n) holding row word ``w``
+    for every row — from the fixed-path deinterleave or from the xpack
+    dense row-window matrix alike.  Returns ``(datas, valid, slots)`` where
+    ``datas`` has ``None`` at variable-width columns and ``slots`` carries
+    each variable column's (offset, length) u32 [n, 2] pairs.  Every fixed
+    slot is aligned to its own size and string slots to 4
+    (compute_column_information, ``row_conversion.cu:1331-1370``), so no
+    fragment straddles a word.
+    """
     datas = []
+    slots = []
     for ci, dt in enumerate(layout.schema):
         start = layout.column_starts[ci]
         size = layout.column_sizes[ci]
+        if dt.is_variable_width:
+            slots.append(jnp.stack([word(start // 4)[:n],
+                                    word(start // 4 + 1)[:n]], axis=1))
+            datas.append(None)
+            continue
         if size == 16:   # DECIMAL128: four words → [n, 2] int64 lanes
             quad = jnp.stack([word(start // 4 + j) for j in range(4)],
                              axis=1)[:n]
@@ -345,7 +350,20 @@ def _from_rows_fixed_words(layout: RowLayout, flat: jnp.ndarray):
                & jnp.uint32(1))
         vcols.append(bit.astype(jnp.bool_)[:n])
     valid = jnp.stack(vcols, axis=1)
-    return tuple(datas), valid
+    return tuple(datas), valid, tuple(slots)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _from_rows_fixed_words(layout: RowLayout, flat: jnp.ndarray):
+    """Flat u32 row words [n*W] → (datas tuple, valid bool [n, ncols])."""
+    W = layout.fixed_row_size // 4
+    n = flat.shape[0] // W
+    n_pad = -(-n // 128) * 128
+    if n_pad != n:
+        flat = jnp.pad(flat, (0, (n_pad - n) * W))
+    t2 = _deinterleave_words(flat, W)                    # [W, n_pad]
+    datas, valid, _ = _decode_row_words(layout, lambda w: t2[w], n)
+    return datas, valid
 
 
 # Fused whole-call cores for the public fixed-width path.  The orchestration
@@ -848,8 +866,17 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
                 for ci, dt in enumerate(schema)]
         return Table(cols)
 
-    from . import ragged
+    from . import ragged, xpack
     from ..utils import hostcache
+    if os.environ.get("SRJT_XPACK", "1").lower() not in ("0", "off"):
+        # primary engine (round 5): the inverse xpack — one fused program
+        # for the whole batch, one memoized stacked sync for the geometry
+        # (copy_strings_from_rows + chars-scan analog,
+        # row_conversion.cu:1131-1174, 2201-2246)
+        res = xpack.from_rows_var_x(layout, batch)
+        if res is not None:
+            datas, valid, chars, out_offsets = res
+            return _assemble(schema, datas, valid, chars, list(out_offsets))
     bdata = batch.device_u8()   # var path is byte-granular (DMA engine)
     if (ragged.dma_supported()
             and len(layout.variable_column_indices) <= _DMA_MAX_VAR_COLS):

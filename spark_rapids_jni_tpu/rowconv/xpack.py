@@ -40,6 +40,19 @@ from .layout import RowLayout
 LANE = 128
 WIN_W = 128                    # output pack window: 128 u32 words = 512 B
 
+# Fallback accounting (VERDICT r4 weak #3): every geometry-plan rejection
+# increments a named counter and emits one structured-log event, so a bench
+# or query run can say exactly WHY a conversion degraded to a slower path.
+fallback_counts: dict[str, int] = {}
+
+
+def _reject(reason: str, **fields):
+    """Record a geometry-cap rejection; returns None (the plan result)."""
+    fallback_counts[reason] = fallback_counts.get(reason, 0) + 1
+    from ..utils import structured_log
+    structured_log.event("xpack_fallback", reason=reason, **fields)
+    return None
+
 
 def _bucket(x: int, lo: int = 8) -> int:
     """≤ ~12.5% growth bucket (pow2/8 multiples) to bound jit variants."""
@@ -172,13 +185,7 @@ def extract_group_windows(chars_u8: jnp.ndarray, offs: jnp.ndarray,
                            n - 1) if n else jnp.zeros(0, jnp.int32)
         amt = offs[ridx] - blk * B               # byte offset, [0, 2B)
         w = _take_words(slab, amt // 4, Lw + 1)
-        a, nxt = w[:, :Lw], w[:, 1:Lw + 1]
-        rb = (amt % 4).astype(jnp.uint32)[:, None]
-        rolled = a
-        for k in (1, 2, 3):
-            v = (a >> jnp.uint32(8 * k)) | (nxt << jnp.uint32(32 - 8 * k))
-            rolled = jnp.where(rb == k, v, rolled)
-        outs.append(rolled)
+        outs.append(_roll_left_bytes(w, Lw, amt % 4))
     out = jnp.stack(outs, axis=1).reshape(ngroups * g, Lw)
     return out[:n]
 
@@ -227,6 +234,19 @@ def pack_windows(dense: jnp.ndarray, dst_w: jnp.ndarray, total_w: int,
         acc = acc | jnp.where(live[:, None], placed & mask, jnp.uint32(0))
     out = acc[:, Mw:Mw + WIN_W].reshape(-1)
     return out[:total_w]
+
+
+def _roll_left_bytes(w: jnp.ndarray, Lw: int, rb: jnp.ndarray) -> jnp.ndarray:
+    """[n, Lw+1] u32 word windows → [n, Lw]: shift each row LEFT by
+    rb∈[0,4) bytes (the payload starts ``rb`` bytes into word 0).  The
+    shared inner roll of every window-extraction site."""
+    a, nxt = w[:, :Lw], w[:, 1:Lw + 1]
+    rbc = rb.astype(jnp.uint32)[:, None]
+    out = a
+    for k in (1, 2, 3):
+        v = (a >> jnp.uint32(8 * k)) | (nxt << jnp.uint32(32 - 8 * k))
+        out = jnp.where(rbc == k, v, out)
+    return out
 
 
 def _byte_funnel_right(win: jnp.ndarray, rb: jnp.ndarray) -> jnp.ndarray:
@@ -288,7 +308,7 @@ def plan_segmented_gather(src_starts_np: np.ndarray, lens_np: np.ndarray,
     # (P explodes with ~64 groups per window) must degrade to the caller's
     # fallback, not compile a P-times-unrolled combine
     if B > (1 << 20) or Lw > 512 or Bd > 512 or P > 64:
-        return None
+        return _reject("seg_gather_caps_host", B=B, Lw=Lw, Bd=Bd, P=int(P))
     return (n, g, B, Lw, Bd, int(P), nwin, total)
 
 
@@ -330,7 +350,7 @@ def plan_from_device_stats(stats, n: int):
     Bd = _bucket(-(-max(dspan, 1) // 4) + 1, 8)
     P = _bucket(max_p, 2)
     if B > (1 << 20) or Lw > 512 or Bd > 512 or P > 64:
-        return None
+        return _reject("seg_gather_caps_dev", B=B, Lw=Lw, Bd=Bd, P=int(P))
     nwin = -(-total // 512)
     return (n, g, B, Lw, Bd, int(P), nwin, total)
 
@@ -361,19 +381,21 @@ def segmented_gather(geom, src_u8: jnp.ndarray, src_starts: jnp.ndarray,
         live = (jnp.arange(ngroups, dtype=jnp.int32) * g + j) < n
         amt = src_starts[ridx] - blk * B
         w = _take_words(slab, amt // 4, Lw + 1)
-        a, nxt = w[:, :Lw], w[:, 1:Lw + 1]
-        rb = (amt % 4).astype(jnp.uint32)[:, None]
-        piece = a
-        for k in (1, 2, 3):
-            v = (a >> jnp.uint32(8 * k)) | (nxt << jnp.uint32(32 - 8 * k))
-            piece = jnp.where(rb == k, v, piece)
+        piece = _roll_left_bytes(w, Lw, amt % 4)
         drel = dst_offs[ridx] - dstg[:-1]
         fun = _byte_funnel_right(piece, drel % 4)
         placed = _place_words(fun, drel // 4, Bd)
         mask = _byte_mask(Bd, drel, drel + lens[ridx])
         acc = acc | jnp.where(live[:, None], placed & mask, jnp.uint32(0))
 
-    # window combine (byte-granular group destinations)
+    return _group_windows_combine(acc, dstg, ngroups, Bd, P, nwin, total)
+
+
+def _group_windows_combine(acc: jnp.ndarray, dstg: jnp.ndarray,
+                           ngroups: int, Bd: int, P: int, nwin: int,
+                           total: int) -> jnp.ndarray:
+    """Window combine: group accumulators [ngroups, Bd] u32 at byte-granular
+    group destinations ``dstg`` [ngroups+1] → packed u8 [total]."""
     fr = _first_row_per_window(dstg, ngroups, nwin, 512)
     fr = jnp.clip(fr, 0, ngroups - 1)
     padded = jnp.pad(acc, ((0, P), (0, 0)))
@@ -470,7 +492,7 @@ def _plan_geometry(layout: RowLayout, n: int, offs_np: np.ndarray,
     row_sizes = offs_np[1:] - offs_np[:-1]
     Mw = _bucket(-(-int(row_sizes.max()) // 4), 8)
     if Mw > 256:                                  # > 1KB rows: fall back
-        return None
+        return _reject("to_rows_row_width", Mw=Mw)
     nwin = -(-(total // 4) // WIN_W)
     # max rows overlapping one output window
     fr = np.searchsorted(offs_np, np.arange(nwin, dtype=np.int64) * 512,
@@ -493,7 +515,7 @@ def _plan_geometry(layout: RowLayout, n: int, offs_np: np.ndarray,
         B = _bucket(max(span, 64), 64)
         Lw = _bucket(-(-Lmax // 4), 4)
         if B > (1 << 20) or Lw > 512:
-            return None
+            return _reject("to_rows_col_caps", col=vi, B=B, Lw=Lw)
         colgeo.append((B, Lw))
     return (n, Mw, int(P), nwin, total // 4, g, tuple(colgeo))
 
@@ -532,3 +554,226 @@ def to_rows_var_x(layout: RowLayout, sub, offs_np: np.ndarray,
         tuple(c.data for c in sub.columns),
         tuple(sub[ci].offsets for ci in var_idx),
         tuple(c.validity for c in sub.columns))
+
+
+# ---------------------------------------------------------------------------
+# from_rows: whole-batch fused program (the inverse engine, round 5)
+# ---------------------------------------------------------------------------
+#
+# Inverse of ``to_rows_var_x`` — the same job as the reference's
+# ``copy_strings_from_rows`` + chars scan + make_strings_column
+# (row_conversion.cu:1131-1174, 2201-2246): packed JCUDF rows → fixed
+# column payloads + validity + per-column chars streams, all on device.
+# Rows are ordered byte segments, so the to_rows primitives invert:
+# row-slab gathers pull per-row word windows (rows are 8-byte aligned →
+# word-granular, no byte funnel), the shared word decoder extracts fixed
+# slots/validity/(offset,len) string slots, and each string column's chars
+# are cut from the dense rows with a narrowing roll tree and re-packed at
+# in-trace-cumsum destinations with the same group-accumulate + window
+# combine as ``segmented_gather``.  ONE stacked scalar sync resolves the
+# per-column char totals (the reference syncs on the same scanned totals,
+# row_conversion.cu:2215); it is memoized on the batch arrays, so the
+# analytics steady state is pure dispatch.
+
+
+def _extract_row_windows(words: jnp.ndarray, offs: jnp.ndarray,
+                         n: int, g: int, Bw: int, Mw: int) -> jnp.ndarray:
+    """Per-row word windows [n, Mw] u32 from the flat row-word stream.
+
+    One slab gather per GROUP of ``g`` rows (the group's rows span ≤ Bw
+    words — caller sizes Bw from the host row offsets), then ``g`` fused
+    word-shift takes pull each row's window out of its group slab.  Bytes
+    beyond a row's true size are unspecified (callers mask by length).
+    """
+    ngroups = -(-n // g)
+    T = words.shape[0]
+    nb = max(-(-T // Bw), 1)
+    w2 = jnp.pad(words, (0, nb * Bw - T)).reshape(nb, Bw)
+    nxt = jnp.concatenate([w2[1:], jnp.zeros((1, Bw), jnp.uint32)])
+    v2 = jnp.concatenate([w2, nxt], axis=1)           # [nb, 2Bw]
+    offs_w = (offs // 4).astype(jnp.int32)
+    gidx = jnp.minimum(jnp.arange(ngroups, dtype=jnp.int32) * g, n - 1)
+    gstart = offs_w[gidx]
+    blk = gstart // Bw
+    slab = v2[jnp.clip(blk, 0, nb - 1)]               # [ngroups, 2Bw]
+    outs = []
+    for j in range(g):
+        ridx = jnp.minimum(jnp.arange(ngroups, dtype=jnp.int32) * g + j,
+                           n - 1)
+        amt = offs_w[ridx] - blk * Bw
+        outs.append(_take_words(slab, amt, Mw))
+    out = jnp.stack(outs, axis=1).reshape(ngroups * g, Mw)
+    return out[:n]
+
+
+def _combine_to_stream(piece: jnp.ndarray, lens: jnp.ndarray,
+                       dst_offs: jnp.ndarray, n: int, g: int, Bd: int,
+                       P: int, nwin: int, total: int) -> jnp.ndarray:
+    """Per-row byte pieces [n, Lw] u32 (payload starts at byte 0, ``lens``
+    bytes live) → packed u8 [total] at byte destinations ``dst_offs``.
+    Group-accumulate then window-combine — the segment-packing half of
+    ``segmented_gather`` with the pieces already in hand."""
+    ngroups = -(-n // g)
+    pad = ngroups * g - n
+    piece3 = jnp.pad(piece, ((0, pad), (0, 0))).reshape(
+        ngroups, g, piece.shape[1])
+    lens2 = jnp.pad(lens, (0, pad)).reshape(ngroups, g)
+    dstp = jnp.pad(dst_offs[:-1], (0, pad)).reshape(ngroups, g)
+    gi = jnp.minimum(jnp.arange(ngroups + 1, dtype=jnp.int32) * g, n)
+    dstg = dst_offs[gi]
+    live_base = jnp.arange(ngroups, dtype=jnp.int32) * g
+    acc = jnp.zeros((ngroups, Bd), jnp.uint32)
+    for j in range(g):
+        live = (live_base + j) < n
+        drel = dstp[:, j] - dstg[:-1]
+        fun = _byte_funnel_right(piece3[:, j], drel % 4)
+        placed = _place_words(fun, drel // 4, Bd)
+        mask = _byte_mask(Bd, drel, drel + lens2[:, j])
+        acc = acc | jnp.where(live[:, None], placed & mask, jnp.uint32(0))
+    return _group_windows_combine(acc, dstg, ngroups, Bd, P, nwin, total)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _from_rows_x_stats(layout: RowLayout, geom_a, words, offs):
+    """Device geometry stats for the inverse engine: [nvar, 5] int64 rows
+    of [char total, slot-violation count, Lmax, max group dst span, max
+    groups per 512B window] — resolved with ONE stacked host sync.  XLA
+    dead-code-eliminates the fixed-column decode this shares with the main
+    program."""
+    from .convert import _decode_row_words
+    n, Mw, g, Bw = geom_a
+    dense = _extract_row_windows(words, offs, n, g, Bw, Mw)
+    _, _, slots = _decode_row_words(layout, lambda w: dense[:, w], n)
+    fpv = layout.fixed_plus_validity
+    row_sizes = (offs[1:] - offs[:-1]).astype(jnp.int64)
+    ngroups = -(-n // g)
+    gi = jnp.minimum(jnp.arange(ngroups + 1) * g, n)
+    rows = []
+    for s in slots:
+        off = s[:, 0].astype(jnp.int64)
+        ln = s[:, 1].astype(jnp.int64)
+        viol = jnp.sum(((off < fpv) | (off + ln > row_sizes))
+                       .astype(jnp.int64))
+        dst = jnp.concatenate([jnp.zeros(1, jnp.int64), jnp.cumsum(ln)])
+        dstg = dst[gi]
+        dspan = jnp.max(dstg[1:] - dstg[:-1])
+        upto = jnp.searchsorted(dstg[:-1], dstg[:-1] + 512, side="left")
+        max_p = jnp.max(upto - jnp.arange(ngroups)) + 1
+        rows.append(jnp.stack([dst[-1], viol, jnp.max(ln), dspan, max_p]))
+    return jnp.stack(rows)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _from_rows_x_jit(layout: RowLayout, geom, words, offs):
+    """geom: (n, Mw, g, Bw, colgeo) with per-column (Lw, Bd, P, nwin,
+    total) — all static.  Returns (datas — None at var columns, valid
+    [n, ncols] bool, chars u8 tuple, out_offsets int32 [n+1] tuple), one
+    dispatch, zero internal syncs."""
+    from .convert import _decode_row_words
+    n, Mw, g, Bw, colgeo = geom
+    dense = _extract_row_windows(words, offs, n, g, Bw, Mw)
+    datas, valid, slots = _decode_row_words(layout, lambda w: dense[:, w], n)
+    chars = []
+    out_offs = []
+    for vi, s in enumerate(slots):
+        Lw, Bd, P, nwin, total = colgeo[vi]
+        lens = s[:, 1].astype(jnp.int32)
+        dst = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(lens)])
+        out_offs.append(dst)
+        if total == 0:
+            chars.append(jnp.zeros((0,), jnp.uint8))
+            continue
+        off_b = s[:, 0].astype(jnp.int32)
+        w = _take_words(dense, off_b // 4, Lw + 1)
+        piece = _roll_left_bytes(w, Lw, off_b % 4)
+        chars.append(_combine_to_stream(piece, lens, dst, n, g, Bd, P,
+                                        nwin, total))
+    return datas, valid, tuple(chars), tuple(out_offs)
+
+
+def _plan_from_rows_a(n: int, offs_np: np.ndarray):
+    """Row-extraction geometry (n, Mw, g, Bw) from the host row offsets
+    alone — needed before the stats program can run.  None (with fallback
+    accounting) outside the buckets."""
+    g = 8
+    row_sizes = offs_np[1:] - offs_np[:-1]
+    Mw = _bucket(-(-int(row_sizes.max(initial=8)) // 4), 8)
+    if Mw > 256:                                  # > 1KB rows
+        return _reject("from_rows_row_width", Mw=Mw)
+    idx = np.minimum(np.arange(0, n + g, g), n)
+    span_w = int(((offs_np[idx[1:]] - offs_np[idx[:-1]]) // 4).max(initial=16))
+    Bw = _bucket(max(span_w, 16), 16)
+    if Bw * 4 > (1 << 20):
+        return _reject("from_rows_slab", Bw=Bw)
+    return (n, Mw, g, Bw)
+
+
+def _plan_from_rows_cols(stats: np.ndarray):
+    """Per-column packing geometry from the device stats sync, or None."""
+    colgeo = []
+    for vi in range(stats.shape[0]):
+        total, _viol, lmax, dspan, max_p = (int(x) for x in stats[vi])
+        if total == 0:
+            colgeo.append((0, 0, 0, 0, 0))
+            continue
+        if total >= (1 << 31):
+            return _reject("from_rows_total", col=vi, total=total)
+        Lw = _bucket(-(-max(lmax, 1) // 4) + 1, 4)
+        Bd = _bucket(-(-max(dspan, 1) // 4) + 1, 8)
+        P = _bucket(max_p, 2)
+        if Lw > 512 or Bd > 512 or P > 64:
+            return _reject("from_rows_col_caps", col=vi, Lw=Lw, Bd=Bd, P=P)
+        colgeo.append((Lw, Bd, int(P), -(-total // 512), total))
+    return tuple(colgeo)
+
+
+def batch_words(batch) -> jnp.ndarray:
+    """The batch's JCUDF stream as u32 words (converts a u8 batch)."""
+    from .convert import _bytes_to_words
+    return (batch.data if batch.data.dtype == jnp.uint32
+            else _bytes_to_words(batch.data))
+
+
+def plan_from_rows(layout: RowLayout, batch, words: jnp.ndarray):
+    """Full static geometry for the inverse engine, or None outside the
+    buckets (with fallback accounting).
+
+    Costs ONE stacked scalar sync (char totals + slot-bounds violations +
+    packing spans, device-reduced) on a memo miss; memoized on the batch
+    arrays, so the analytics steady state re-plans nothing.  Raises
+    ``ValueError`` on corrupt embedded slots, same hardening as the host
+    engine (rows may be shuffle-received).
+    """
+    from ..utils import hostcache, syncs
+    n = batch.num_rows
+    if n == 0:
+        return None
+    offs_np = hostcache.host_i64(batch.offsets)
+    if int(offs_np[-1]) == 0 or int(offs_np[-1]) % 4:
+        return None
+    tag = f"xunpack_geom:{hash(layout)}"
+    geom = syncs.memo_get(tag, [batch.data, batch.offsets])
+    if geom is None:
+        geom_a = _plan_from_rows_a(n, offs_np)
+        if geom_a is None:
+            return None
+        stats = np.asarray(_from_rows_x_stats(
+            layout, geom_a, words, batch.offsets))           # ONE sync
+        if stats[:, 1].any():
+            raise ValueError("corrupt row data: string slot outside its row")
+        colgeo = _plan_from_rows_cols(stats)
+        if colgeo is None:
+            return None
+        geom = geom_a + (colgeo,)
+        syncs.memo_put(tag, [batch.data, batch.offsets], geom)
+    return geom
+
+
+def from_rows_var_x(layout: RowLayout, batch):
+    """Packed JCUDF rows → (datas, valid, chars, out_offsets), one fused
+    program; None (caller falls back) outside the geometry buckets."""
+    words = batch_words(batch)
+    geom = plan_from_rows(layout, batch, words)
+    if geom is None:
+        return None
+    return _from_rows_x_jit(layout, geom, words, batch.offsets)
