@@ -33,8 +33,14 @@ from __future__ import annotations
 from typing import Any, Callable
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 from ..utils import syncs
+
+
+class StaleTapeError(ValueError):
+    """The compiled plan's recorded sizes no longer match the data."""
 
 
 def _materialized(result):
@@ -48,8 +54,14 @@ def _materialized(result):
 class CompiledQuery:
     """A query function compiled to one jitted program over its tables.
 
-    ``run(tables)`` executes the single-dispatch program.  ``tape`` is the
-    recorded size vector (diagnostic; its length is the eager sync count).
+    ``run(tables)`` executes the single-dispatch program, first verifying
+    — with ONE stacked scalar sync — that the data's true resolved sizes
+    still match the recorded tape (the reference re-measures its sizes on
+    every call, ``row_conversion.cu:2205-2215``; a replay against
+    refreshed data with different join cardinalities would otherwise
+    return wrong rows without any error).  ``run_unchecked`` skips the
+    check for the steady loop over data already verified once.  ``tape``
+    is the recorded size vector (its length is the eager sync count).
     """
 
     def __init__(self, qfn: Callable, tables: Any):
@@ -66,7 +78,37 @@ class CompiledQuery:
         _traced.__name__ = f"compiled_{qname}"
         self._prog = jax.jit(_traced)
 
+        def _sizes(tbls):
+            seen: list = []
+            with syncs.replay(list(self.tape), collect=seen):
+                _materialized(qfn(tbls))
+            if not seen:
+                return jnp.zeros((0,), jnp.int64)
+            return jnp.stack([jnp.asarray(x).astype(jnp.int64).reshape(())
+                              for x in seen])
+        _sizes.__name__ = f"sizes_{qname}"
+        # everything not feeding a resolution site is dead code, so this
+        # program is the PREFIX of the query that produces its sizes
+        self._sizes_prog = jax.jit(_sizes)
+
     def run(self, tables):
+        """Checked execution: one stacked sync validates the tape, then
+        one dispatch runs the plan.  Raises :class:`StaleTapeError` when
+        the data's resolved sizes differ from the capture run's."""
+        if self.tape:
+            actual = np.asarray(self._sizes_prog(tables))
+            if tuple(int(v) for v in actual) != self.tape:
+                diffs = [i for i, (a, b) in enumerate(zip(actual, self.tape))
+                         if int(a) != b]
+                raise StaleTapeError(
+                    f"compiled plan is stale: resolved sizes differ from "
+                    f"the capture run at tape positions {diffs[:8]} "
+                    f"(of {len(self.tape)}) — re-run compile_query on the "
+                    "refreshed tables")
+        return self._prog(tables)
+
+    def run_unchecked(self, tables):
+        """Steady-loop execution: no staleness check, one dispatch."""
         return self._prog(tables)
 
     def lower_text(self, tables) -> str:

@@ -755,18 +755,20 @@ def plan_from_rows(layout: RowLayout, batch, words: jnp.ndarray):
     geom = syncs.memo_get(tag, [batch.data, batch.offsets])
     if geom is None:
         geom_a = _plan_from_rows_a(n, offs_np)
-        if geom_a is None:
-            return None
-        stats = np.asarray(_from_rows_x_stats(
-            layout, geom_a, words, batch.offsets))           # ONE sync
-        if stats[:, 1].any():
-            raise ValueError("corrupt row data: string slot outside its row")
-        colgeo = _plan_from_rows_cols(stats)
-        if colgeo is None:
-            return None
-        geom = geom_a + (colgeo,)
-        syncs.memo_put(tag, [batch.data, batch.offsets], geom)
-    return geom
+        if geom_a is not None:
+            stats = np.asarray(_from_rows_x_stats(
+                layout, geom_a, words, batch.offsets))       # ONE sync
+            if stats[:, 1].any():
+                raise ValueError(
+                    "corrupt row data: string slot outside its row")
+            colgeo = _plan_from_rows_cols(stats)
+            geom = None if colgeo is None else geom_a + (colgeo,)
+        # rejections memoize too (as "reject"): a repeat conversion of an
+        # out-of-cap batch must not re-run the stats program + sync, nor
+        # re-increment the fallback counters, on every call
+        syncs.memo_put(tag, [batch.data, batch.offsets],
+                       geom if geom is not None else "reject")
+    return None if geom == "reject" else geom
 
 
 def from_rows_var_x(layout: RowLayout, batch):

@@ -40,6 +40,7 @@ _count = 0
 _mode = "normal"            # "normal" | "capture" | "replay"
 _tape: list[int] = []
 _tape_pos = 0
+_seen: list | None = None   # replay-time collection of the device values
 
 
 def mode() -> str:
@@ -60,12 +61,17 @@ def capture(tape: list[int]):
 
 
 @contextlib.contextmanager
-def replay(tape: list[int]):
-    """Traced run resolving sizes from ``tape`` instead of device syncs."""
-    global _mode, _tape, _tape_pos
+def replay(tape: list[int], collect: list | None = None):
+    """Traced run resolving sizes from ``tape`` instead of device syncs.
+
+    ``collect``, when given, receives the value that ARRIVED at each
+    :func:`scalar` call (a tracer under jit) in tape order — the raw
+    material for a device-side size-vector program that can check a tape
+    against refreshed data (``models/compiled.py`` staleness guard)."""
+    global _mode, _tape, _tape_pos, _seen
     if _mode != "normal":
         raise RuntimeError(f"cannot replay while in {_mode} mode")
-    _mode, _tape, _tape_pos = "replay", list(tape), 0
+    _mode, _tape, _tape_pos, _seen = "replay", list(tape), 0, collect
     try:
         yield
         if _tape_pos != len(_tape):
@@ -73,7 +79,7 @@ def replay(tape: list[int]):
                 f"replay consumed {_tape_pos} of {len(_tape)} recorded "
                 "sizes — plan diverged from the capture run")
     finally:
-        _mode, _tape, _tape_pos = "normal", [], 0
+        _mode, _tape, _tape_pos, _seen = "normal", [], 0, None
 
 
 def scalar(x) -> int:
@@ -83,6 +89,8 @@ def scalar(x) -> int:
         if _tape_pos >= len(_tape):
             raise RuntimeError(
                 "replay tape exhausted — plan diverged from the capture run")
+        if _seen is not None:
+            _seen.append(x)
         v = _tape[_tape_pos]
         _tape_pos += 1
         return v

@@ -63,15 +63,20 @@ def main():
     res["cold_syncs"] = syncs.reset_sync_count()
     print(f"cold: {res['cold_s']}s  syncs={res['cold_syncs']}", flush=True)
 
-    out = cq.run(tables)                    # compile the fused program
+    out = cq.run(tables)           # compile the fused + size programs
     np.asarray(out[0].data[:1])
     syncs.reset_sync_count()
     t0 = time.perf_counter()
-    out = cq.run(tables)
+    out = cq.run(tables)           # checked: staleness guard sync included
     jax.block_until_ready([c.data for c in out.columns])
     np.asarray(out[0].data[:1])
     res["warm_s"] = round(time.perf_counter() - t0, 3)
     res["warm_syncs"] = syncs.reset_sync_count()
+    t0 = time.perf_counter()
+    out = cq.run_unchecked(tables)  # the one-dispatch steady form
+    jax.block_until_ready([c.data for c in out.columns])
+    np.asarray(out[0].data[:1])
+    res["warm_unchecked_s"] = round(time.perf_counter() - t0, 3)
     res["rows_out"] = out.num_rows
     print(f"warm: {res['warm_s']}s  syncs={res['warm_syncs']}  "
           f"rows={res['rows_out']}", flush=True)

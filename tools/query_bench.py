@@ -104,8 +104,9 @@ def main():
     RESULTS["load_s"] = round(load_s, 1)
     print(f"decode+upload: {load_s:.1f}s", flush=True)
 
-    chosen = (sys.argv[3].split(",") if len(sys.argv) > 3
-              else ["q3", "q55", "q62", "q_state_rollup", "q_having"])
+    chosen = (sorted(tpcds.QUERIES)
+              if len(sys.argv) <= 3 or sys.argv[3] == "all"
+              else sys.argv[3].split(","))
     for name in chosen:
         fn = tpcds.QUERIES[name]
         entry = {}
@@ -121,8 +122,11 @@ def main():
             entry["cold_syncs"] = syncs.reset_sync_count()
             entry["tape_len"] = len(cq.tape)
 
-            # warm: the one-program form, wall incl. result pull
-            out = cq.run(tables)          # compile the fused program
+            # warm: the one-program form, wall incl. result pull.
+            # run() is the production API (validates the tape against the
+            # data with one stacked sync — models/compiled.py staleness
+            # guard); run_unchecked is the steady loop over verified data.
+            out = cq.run(tables)          # compile the fused + size programs
             jax.block_until_ready([c.data for c in out.columns])
             if out.num_rows:
                 np.asarray(out[0].data[:1])
@@ -134,6 +138,12 @@ def main():
                 np.asarray(out[0].data[:1])
             entry["warm_wall_s"] = round(time.perf_counter() - t0, 3)
             entry["warm_syncs"] = syncs.reset_sync_count()
+            t0 = time.perf_counter()
+            out = cq.run_unchecked(tables)
+            jax.block_until_ready([c.data for c in out.columns])
+            if out.num_rows:
+                np.asarray(out[0].data[:1])
+            entry["warm_unchecked_s"] = round(time.perf_counter() - t0, 3)
             entry["rows_out"] = out.num_rows
 
             # steady: differenced in-jit device time per execution
