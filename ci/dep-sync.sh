@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Dependency refresh — the analog of the reference's ci/submodule-sync.sh
+# (bump thirdparty/cudf to branch HEAD, rebuild, push if green).  The
+# moving dependency here is JAX: install the latest release, run the
+# build + suite against it, and leave a green-marker + version for the
+# workflow to branch on.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT=target/dep-sync
+mkdir -p "$OUT"
+rm -f "$OUT/green"
+
+python -m pip install -U jax
+python - <<'PYEOF' > "$OUT/version"
+import jax
+print(jax.__version__, end="")
+PYEOF
+echo "testing against jax $(cat "$OUT/version")"
+
+bash ci/premerge.sh --skip-tests
+if XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q | tee "$OUT/pytest.log"; then
+    touch "$OUT/green"
+    echo "dep-sync: GREEN against jax $(cat "$OUT/version")"
+else
+    echo "dep-sync: suite FAILED against jax $(cat "$OUT/version")" >&2
+    exit 1
+fi

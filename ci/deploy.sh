@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Deploy — the analog of the reference's ci/deploy.sh (mvn deploy of the
+# versioned jar to the maven repo).  Publishes the built wheel to the
+# package index configured via SRJT_DEPLOY_URL; without one (local runs,
+# forks) it verifies the artifact and stops — a dry run, never a failure.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+ARTIFACT_DIR=${1:-target/nightly}
+
+WHEEL=$(ls "$ARTIFACT_DIR"/*.whl 2>/dev/null | head -1 || true)
+if [[ -z "$WHEEL" ]]; then
+    WHEEL=$(ls dist/*.whl 2>/dev/null | head -1 || true)
+fi
+[[ -n "$WHEEL" ]] || { echo "deploy: no wheel found" >&2; exit 1; }
+
+echo "== verify artifact =="
+python -m zipfile -l "$WHEEL" | grep -q "libsrjt.so" \
+    || { echo "deploy: wheel is missing the native artifact" >&2; exit 1; }
+
+if [[ -z "${SRJT_DEPLOY_URL:-}" ]]; then
+    echo "deploy: SRJT_DEPLOY_URL not set — dry run, artifact verified:"
+    ls -la "$WHEEL"
+    exit 0
+fi
+
+echo "== upload to $SRJT_DEPLOY_URL =="
+python -m pip install -q twine
+python -m twine upload --repository-url "$SRJT_DEPLOY_URL" "$WHEEL"

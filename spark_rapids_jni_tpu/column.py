@@ -235,10 +235,12 @@ class LazyColumn(Column):
     (``row_conversion.cu:1460-1539``); here oversize is avoided by never
     materializing what isn't referenced.
 
-    Forcing inside a ``jax.jit`` trace is well-defined: the deferred gather
-    simply becomes part of the traced program (better fusion than the eager
-    form).  ``tree_flatten`` forces, so jit boundaries see a plain column;
-    ``tree_unflatten`` rebuilds an eager :class:`Column`.
+    Forcing *via attribute access inside an active trace* is well-defined:
+    the deferred gather becomes part of the traced program (better fusion
+    than the eager form).  Passing a LazyColumn ACROSS a jit boundary does
+    NOT fuse it: ``tree_flatten`` runs at the jit argument boundary —
+    outside the trace — so the column materializes eagerly there and the
+    trace sees a plain :class:`Column` (``tree_unflatten`` rebuilds one).
     """
 
     def __init__(self, dtype: T.DType, num_rows: int, thunk):

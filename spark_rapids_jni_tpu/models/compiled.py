@@ -96,6 +96,7 @@ class CompiledQuery:
         one dispatch runs the plan.  Raises :class:`StaleTapeError` when
         the data's resolved sizes differ from the capture run's."""
         if self.tape:
+            syncs.note_sync()        # the guard's one stacked D2H pull
             actual = np.asarray(self._sizes_prog(tables))
             if tuple(int(v) for v in actual) != self.tape:
                 diffs = [i for i, (a, b) in enumerate(zip(actual, self.tape))

@@ -94,13 +94,20 @@ long srjt_snappy_decompress(const unsigned char* src, long src_len,
 // total, or -1 on truncation/overflow.
 long srjt_byte_array_offsets(const unsigned char* payload, long size,
                              long n, int32_t* out_offs) {
+  // the memcpy below reinterprets the 4-byte little-endian length prefix
+  // as a host u32 — refuse to build on a big-endian target rather than
+  // silently mis-walking the payload
+#if defined(__BYTE_ORDER__) && defined(__ORDER_LITTLE_ENDIAN__)
+  static_assert(__BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__,
+                "srjt_byte_array_offsets assumes a little-endian host");
+#endif
   long pos = 0;
   long total = 0;
   out_offs[0] = 0;
   for (long i = 0; i < n; ++i) {
     if (pos + 4 > size) return -1;
     uint32_t len;
-    std::memcpy(&len, payload + pos, 4);   // little-endian host assumed
+    std::memcpy(&len, payload + pos, 4);
     pos += 4;
     if (len > static_cast<uint64_t>(size - pos)) return -1;
     pos += len;

@@ -75,9 +75,13 @@ def concat_tables(tables: Sequence[Table]) -> Table:
                 raise TypeError(
                     f"concat dtype mismatch: {t[i].dtype} vs {dt}")
     n_out = sum(t.num_rows for t in tables)
+    # capture only the per-index column list: a thunk closing over the
+    # full `tables` would pin every column of every input (including
+    # already-materialized wide join outputs) until forced or dropped
+    cols_by_index = [[t[i] for t in tables] for i in range(ncols)]
     return Table([
         LazyColumn(tables[0][i].dtype, n_out,
-                   (lambda i=i: _concat_columns([t[i] for t in tables])))
+                   (lambda cols=cols_by_index[i]: _concat_columns(cols)))
         for i in range(ncols)])
 
 

@@ -373,6 +373,13 @@ def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
         if ln.shape[0] == 0 or dst[-1] == 0:
             chars = jnp.zeros(0, jnp.uint8)
         else:
+            # the gather works in int32 positions; a concatenated multi-
+            # chunk payload approaching 2 GiB would wrap the casts below
+            # and corrupt the decode — fall back to the host path instead
+            # (the native walker only guards per-page char totals)
+            if (base >= 2**31 or int(dst[-1]) >= 2**31
+                    or int(st.max(initial=0)) >= 2**31):
+                return None
             geom = xpack.plan_segmented_gather(st, ln, dst)
             if geom is None:
                 return None

@@ -101,6 +101,14 @@ def scalar(x) -> int:
     return v
 
 
+def note_sync(k: int = 1) -> None:
+    """Count ``k`` intentional D2H syncs that do not flow through
+    :func:`scalar` (e.g. a stacked size-vector pull) — keeps the
+    syncs-per-query funnel honest for non-scalar transfers."""
+    global _count
+    _count += k
+
+
 def sync_count() -> int:
     return _count
 
