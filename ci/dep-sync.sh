@@ -10,11 +10,14 @@ OUT=target/dep-sync
 mkdir -p "$OUT"
 rm -f "$OUT/green"
 
-python -m pip install -U jax
+python -m pip install -U jax numpy pytest pandas pyarrow
 python - <<'PYEOF' > "$OUT/version"
 import jax
 print(jax.__version__, end="")
 PYEOF
+# the tracked pin the bot branch actually bumps (reference analog: the
+# cudf submodule SHA); CI installs whatever this records
+cp "$OUT/version" ci/jax-pin.txt
 echo "testing against jax $(cat "$OUT/version")"
 
 bash ci/premerge.sh --skip-tests

@@ -29,7 +29,7 @@ PYEOF
         echo "== java tier: JVM smoke (RowConversionSmoke) =="
         java -Dsrjt.native.path="$(pwd)/spark_rapids_jni_tpu/native/libsrjt.so" \
             -cp "$CLASSDIR" com.tpu.rapids.jni.RowConversionSmoke \
-            | tee ci/java_smoke.log
+            | tee target/java_smoke.log
     fi
 else
     echo "== java tier: no javac in environment, skipped =="

@@ -49,13 +49,20 @@ _PLAIN_PHYS = {D.PT_INT32: 4, D.PT_INT64: 8, D.PT_FLOAT: 4, D.PT_DOUBLE: 8}
 
 def _walk_chunk_raw(file_bytes: bytes, chunk, max_def: int, max_rep: int,
                     type_len: int = 0):
-    """Page walk that KEEPS raw PLAIN payload bytes (or dictionary+indices)
-    instead of decoding values.  Returns None when the chunk needs the
-    host decoder (unsupported physical type / encoding / nesting).
+    """Page walk that KEEPS raw PLAIN payload bytes (or dictionary+index
+    run plans) instead of decoding values.  Returns None when the chunk
+    needs the host decoder (unsupported physical type / encoding /
+    nesting).
 
-    FIXED_LEN_BYTE_ARRAY chunks (width ≤ 16 — the parquet DECIMAL carrier)
-    are fixed-width too: their payload is kept raw and assembled into
-    decimal limbs on device."""
+    Definition levels and dictionary indices are *not* decoded here
+    (round 5): only their run HEADERS are walked (``rle_device.parse_runs``
+    — O(#runs) host metadata, like page headers) and the bit-stream
+    payload expands on device.  ``present_count`` provides the per-page
+    present-value total the payload slicing needs.  FIXED_LEN_BYTE_ARRAY
+    chunks (width ≤ 16 — the parquet DECIMAL carrier) are fixed-width
+    too: their payload is kept raw and assembled into decimal limbs on
+    device."""
+    from . import rle_device as RLE
     md = chunk.get(D.CC.META_DATA)
     phys = md.get(D.CMD.TYPE)
     is_flba = (phys == D.PT_FIXED_LEN_BYTE_ARRAY
@@ -76,22 +83,48 @@ def _walk_chunk_raw(file_bytes: bytes, chunk, max_def: int, max_rep: int,
     total = md.get(D.CMD.TOTAL_COMPRESSED_SIZE)
     stream = D._PageStream(file_bytes[start:start + total], codec)
 
+    # def-level streams expand on device only when the whole expansion is
+    # a bit test (flat optional column, max_def == 1) and no host stage
+    # needs the concrete mask; the PLAIN-string native offsets walker
+    # scatters by validity on host, so string chunks keep np levels
+    def_bw = D._bit_width(max_def)
+    use_plan_defs = max_def == 1 and not is_str
+
+    def _levels(buf: bytes, n: int):
+        """→ (entry, n_present): entry is None | ("np", arr) |
+        ("plan", RunPlan, n_present)."""
+        if use_plan_defs:
+            plan = RLE.parse_runs(buf, def_bw, n)
+            if plan is not None:
+                npres = RLE.present_count(plan, max_def)
+                if npres == n:
+                    return None, n               # no nulls in this page
+                return ("plan", plan, npres), npres
+        defs = D.decode_rle_bitpacked_hybrid(buf, def_bw, n)
+        return ("np", defs == max_def), int((defs == max_def).sum())
+
     dictionary = None
-    payloads, idx_parts, def_parts, ns = [], [], [], []
+    payloads, idx_parts, def_parts, ns, npres_l = [], [], [], [], []
     decoded = 0
     while decoded < num_values:
         header, raw = stream.next_page()
         ptype = header.get(D.PH.TYPE)
         usize = header.get(D.PH.UNCOMPRESSED_SIZE)
         if ptype == D.PAGE_DICTIONARY:
-            if is_str or is_bool:
-                # dictionary-encoded strings: host path (round-4 device
-                # scope is the PLAIN string stream)
-                return None
             dph = header.get(D.PH.DICT_PAGE)
             data = D._decompress(raw, codec, usize)
             m = dph.get(D.DPH.NUM_VALUES)
-            if is_flba:   # fixed-width byte strings -> host limb decode
+            if is_bool:
+                return None
+            if is_str:
+                # dictionary strings (round 5): keep the dict page RAW —
+                # the native walker stages the sequential offsets
+                # recurrence, chars stay bytes for the device gather
+                offs = D.byte_array_offsets(data, m)
+                if offs is None:
+                    return None
+                dictionary = (bytes(data), offs)
+            elif is_flba:   # fixed-width byte strings -> host limb decode
                 dictionary = D._be_decimal_to_lanes(
                     np.frombuffer(data, np.uint8, m * type_len), type_len)
             else:
@@ -104,12 +137,11 @@ def _walk_chunk_raw(file_bytes: bytes, chunk, max_def: int, max_rep: int,
             enc = dph.get(D.DPH.ENCODING)
             data = D._decompress(raw, codec, usize)
             pos = 0
-            defs = None
+            dentry, n_present = None, n
             if max_def > 0:
                 (ln,) = _struct.unpack_from("<I", data, pos)
                 pos += 4
-                defs = D.decode_rle_bitpacked_hybrid(
-                    data[pos:pos + ln], D._bit_width(max_def), n)
+                dentry, n_present = _levels(data[pos:pos + ln], n)
                 pos += ln
             page_vals = data[pos:]
         elif ptype == D.PAGE_DATA_V2:
@@ -120,15 +152,13 @@ def _walk_chunk_raw(file_bytes: bytes, chunk, max_def: int, max_rep: int,
             body = raw[dl_len:]
             if dph.get(D.DPH2.IS_COMPRESSED, True):
                 body = D._decompress(body, codec, usize - dl_len)
-            defs = None
+            dentry, n_present = None, n
             if max_def > 0 and dl_len:
-                defs = D.decode_rle_bitpacked_hybrid(
-                    raw[:dl_len], D._bit_width(max_def), n)
+                dentry, n_present = _levels(raw[:dl_len], n)
             page_vals = body
         else:
             continue
 
-        n_present = n if defs is None else int((defs == max_def).sum())
         if enc == D.ENC_PLAIN and is_str:
             offs = D.byte_array_offsets(page_vals, n_present)
             if offs is None:
@@ -152,13 +182,21 @@ def _walk_chunk_raw(file_bytes: bytes, chunk, max_def: int, max_rep: int,
                 # decoder like every other unsupported shape
                 return None
             bw = page_vals[0]
-            idx_parts.append(D.decode_rle_bitpacked_hybrid(
-                page_vals[1:], bw, n_present).astype(np.int32))
+            plan = RLE.parse_runs(bytes(page_vals[1:]), bw, n_present) \
+                if n_present else RLE.parse_runs(b"", 0, 0)
+            if plan is not None and n_present:
+                idx_parts.append(("plan", plan))
+            elif n_present:
+                idx_parts.append(("np", D.decode_rle_bitpacked_hybrid(
+                    page_vals[1:], bw, n_present).astype(np.int32)))
+            else:
+                idx_parts.append(("np", np.zeros(0, np.int32)))
             payloads.append(None)
         else:
             return None
-        def_parts.append(defs)
+        def_parts.append(dentry)
         ns.append(n)
+        npres_l.append(n_present)
         decoded += n
 
     has_plain = any(p is not None for p in payloads)
@@ -166,16 +204,11 @@ def _walk_chunk_raw(file_bytes: bytes, chunk, max_def: int, max_rep: int,
     if has_plain and has_dict:
         return None                  # mixed-encoding chunk: host fallback
     n_total = int(sum(ns))
-    valid = None
-    if max_def > 0 and any(d is not None for d in def_parts):
-        valid = np.concatenate(
-            [d == max_def if d is not None else np.ones(k, bool)
-             for d, k in zip(def_parts, ns)])
-        if valid.all():
-            valid = None
+    valid = _assemble_valid(def_parts, ns, force_np=is_str)
     if has_dict:
-        return ("dict", phys, dictionary, np.concatenate(idx_parts),
-                valid, n_total)
+        kind = "dict_str" if is_str else "dict"
+        return (kind, phys, dictionary,
+                [i for i in idx_parts if i is not None], valid, n_total)
     if is_str:
         # per-page (payload, offs) → one stream + global segment geometry
         base = 0
@@ -192,14 +225,34 @@ def _walk_chunk_raw(file_bytes: bytes, chunk, max_def: int, max_rep: int,
                 (b"".join(bufs), np.concatenate(starts_all),
                  np.concatenate(lens_all)), valid, n_total)
     if is_bool:
-        if len(payloads) > 1 and any(
-                (k if d is None else int((d == max_def).sum())) % 8
-                for d, k in list(zip(def_parts, ns))[:-1]):
+        if len(payloads) > 1 and any(k % 8 for k in npres_l[:-1]):
             return None     # bit-misaligned page boundary: host path
         return ("plain_bool", phys, None, b"".join(payloads), valid,
                 n_total)
     payload = b"".join(payloads)
     return ("plain", phys, None, payload, valid, n_total)
+
+
+def _assemble_valid(def_parts, ns, force_np: bool):
+    """Chunk-level validity from per-page level entries: None (no nulls),
+    a host bool array, or ("plans", [(RunPlan|None, n)]) for device
+    expansion."""
+    if not any(d is not None for d in def_parts):
+        return None
+    if force_np or any(d is not None and d[0] == "np" for d in def_parts):
+        from . import rle_device as RLE
+        segs = []
+        for d, k in zip(def_parts, ns):
+            if d is None:
+                segs.append(np.ones(k, bool))
+            elif d[0] == "np":
+                segs.append(d[1])
+            else:
+                segs.append(RLE.expand_np(d[1]) == 1)
+        valid = np.concatenate(segs)
+        return None if valid.all() else valid
+    return ("plans", [(None if d is None else d[1], k)
+                      for d, k in zip(def_parts, ns)])
 
 
 def _u8_to_u32_flat(raw: jnp.ndarray) -> jnp.ndarray:
@@ -320,6 +373,190 @@ def _upload_dict(phys: int, dictionary: np.ndarray) -> jnp.ndarray:
     return jnp.asarray(dictionary)
 
 
+def _valid_needs_np(parts) -> bool:
+    return any(isinstance(p[4], np.ndarray) for p in parts)
+
+
+def _valid_np_concat(parts):
+    """Normalize all chunks' validity to one host bool array (or None)."""
+    from . import rle_device as RLE
+    if not any(p[4] is not None for p in parts):
+        return None
+    segs = []
+    for p in parts:
+        v = p[4]
+        if v is None:
+            segs.append(np.ones(p[5], bool))
+        elif isinstance(v, np.ndarray):
+            segs.append(v)
+        else:
+            for plan, k in v[1]:
+                segs.append(np.ones(k, bool) if plan is None
+                            else RLE.expand_np(plan) == 1)
+    return np.concatenate(segs)
+
+
+def _valid_device_concat(parts):
+    """Device validity: per-page def-level plans expand on chip (bit
+    test), all-valid pages are ones.  None when no chunk has nulls."""
+    from . import rle_device as RLE
+    if not any(p[4] is not None for p in parts):
+        return None
+    segs = []
+    for p in parts:
+        v = p[4]
+        if v is None:
+            segs.append(jnp.ones(p[5], jnp.bool_))
+        elif isinstance(v, np.ndarray):
+            segs.append(jnp.asarray(v))
+        else:
+            for plan, k in v[1]:
+                segs.append(jnp.ones(k, jnp.bool_) if plan is None
+                            else RLE.expand_device(plan) == 1)
+    return segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+
+
+def _idx_device_concat(entries) -> jnp.ndarray:
+    """Dictionary-index entries (("plan", RunPlan) | ("np", arr)) →
+    one int32 device vector; run plans expand on chip."""
+    from . import rle_device as RLE
+    if all(e[0] == "plan" for e in entries):
+        segs = [RLE.expand_device(e[1]) for e in entries]
+        return segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+    return jnp.asarray(np.concatenate(
+        [RLE.expand_np(e[1]) if e[0] == "plan" else e[1]
+         for e in entries]).astype(np.int32))
+
+
+@jax.jit
+def _dict_str_rows(dict_lens: jnp.ndarray, idx: jnp.ndarray, valid):
+    """Per-output-row dictionary entry + chars length (def-level expanded)
+    and the packing stats — shared by the planning sync and the chars
+    program so the two cannot drift."""
+    from ..rowconv import xpack
+    if valid is None:
+        idx_full = idx
+        lens_row = dict_lens[idx_full].astype(jnp.int32)
+    else:
+        pos = jnp.clip(jnp.cumsum(valid.astype(jnp.int32)) - 1, 0,
+                       max(int(idx.shape[0]) - 1, 0))
+        idx_full = jnp.where(valid, idx[pos] if idx.shape[0] else 0, 0)
+        lens_row = jnp.where(valid, dict_lens[idx_full], 0).astype(
+            jnp.int32)
+    dst = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(lens_row)])
+    return idx_full, lens_row, dst, xpack.dst_combine_stats(dst)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _dict_str_chars(geom, dictmat: jnp.ndarray, dict_lens: jnp.ndarray,
+                    idx: jnp.ndarray, valid):
+    """Dictionary-string column body: padded dict rows [Ds, Lw] gathered
+    per output row, then packed to the Arrow chars stream + offsets with
+    the xpack combine — all on device, one program."""
+    from ..rowconv import xpack
+    n, Bd, P, nwin, total = geom
+    idx_full, lens_row, dst, _ = _dict_str_rows(dict_lens, idx, valid)
+    piece = dictmat[idx_full]                       # [n, Lw] u32 rows
+    chars = xpack._combine_to_stream(piece, lens_row, dst, n, 8, Bd, P,
+                                     nwin, total)
+    return chars, dst
+
+
+def _scan_dict_str(parts, jvalid, n_total: int) -> Optional[Column]:
+    """Dictionary-encoded strings fully on device (round 5).
+
+    Host stages only metadata: the dict page's offsets recurrence (native
+    walker) and the index run headers.  Device: one ``segmented_gather``
+    strips the dict page's length prefixes to a contiguous chars stream,
+    ``extract_group_windows`` widens it to a padded [D, Lw] row matrix,
+    the RLE index runs expand to positions, and the xpack combine packs
+    each row's dictionary entry into the Arrow chars stream + offsets.
+    The only sync is ONE stacked packing-geometry pull — the libcudf
+    dict-string decode analog (SURVEY §2.9)."""
+    from ..rowconv import xpack
+
+    # merge per-chunk dictionaries (usually byte-identical)
+    dicts = [p[2] for p in parts]
+    base = dicts[0]
+    same = all(d is base or (d[0] == base[0]
+                             and np.array_equal(d[1], base[1]))
+               for d in dicts[1:])
+    merged = [base] if same else dicts
+    payload = b"".join(d[0] for d in merged)
+    pbase = 0
+    starts_l, lens_l, entc = [], [], []
+    for d in merged:
+        offs = d[1]
+        k = offs.shape[0] - 1
+        lens = (offs[1:] - offs[:-1]).astype(np.int32)
+        starts_l.append(pbase + offs[:-1].astype(np.int64)
+                        + 4 * np.arange(1, k + 1, dtype=np.int64))
+        lens_l.append(lens)
+        entc.append(k)
+        pbase += len(d[0])
+    starts = np.concatenate(starts_l)
+    lens = np.concatenate(lens_l)
+    Ds = int(lens.shape[0])
+    if Ds == 0:
+        return None
+    dict_offs = np.zeros(Ds + 1, np.int64)
+    np.cumsum(lens, out=dict_offs[1:])
+    if pbase >= 2**31 or int(dict_offs[-1]) >= 2**31:
+        return None
+
+    # indices (device), offset-rebased when dictionaries were merged
+    idx_all = []
+    off = 0
+    for ci, p in enumerate(parts):
+        part_idx = _idx_device_concat(p[3])
+        idx_all.append(part_idx + off if off else part_idx)
+        if not same:
+            off += entc[ci]
+    idx = jnp.concatenate(idx_all) if len(idx_all) > 1 else idx_all[0]
+
+    # device dict: strip prefixes → contiguous chars → padded row matrix
+    total_chars = int(dict_offs[-1])
+    Lmax = int(lens.max(initial=0))
+    Lw = xpack._bucket(max(-(-Lmax // 4), 1), 4)
+    if Lw > 512:
+        return xpack._reject("dict_str_entry_len", Lw=Lw)
+    if total_chars:
+        geom_sg = xpack.plan_segmented_gather(starts, lens, dict_offs)
+        if geom_sg is None:
+            return None
+        chars_dict = xpack.segmented_gather(
+            geom_sg, jnp.asarray(np.frombuffer(payload, np.uint8)),
+            jnp.asarray(starts.astype(np.int32)), jnp.asarray(lens),
+            jnp.asarray(dict_offs.astype(np.int32)))
+    else:
+        chars_dict = jnp.zeros(0, jnp.uint8)
+    g = 8
+    gidx = np.minimum(np.arange(0, Ds + g, g), Ds)
+    span = int((dict_offs[gidx[1:]] - dict_offs[gidx[:-1]]).max(initial=1))
+    B = xpack._bucket(max(span, 64), 64)
+    if B > (1 << 20):
+        return xpack._reject("dict_str_slab", B=B)
+    dictmat = xpack.extract_group_windows(
+        chars_dict, jnp.asarray(dict_offs.astype(np.int32)), Ds, g, B, Lw)
+    dict_lens = jnp.asarray(lens)
+
+    # packing geometry: ONE stacked sync (row lens live on device)
+    stats = np.asarray(_dict_str_rows(dict_lens, idx, jvalid)[3])
+    total, dspan, max_p = (int(x) for x in stats)
+    if total >= 2**31:
+        return None
+    if total == 0:
+        offs32 = jnp.zeros(n_total + 1, jnp.int32)
+        return Column(T.string, jnp.zeros(0, jnp.uint8), offs32, jvalid)
+    combine = xpack.plan_combine(total, dspan, max_p, "dict_str_caps")
+    if combine is None:
+        return None
+    Bd, P, nwin = combine
+    geom = (n_total, Bd, P, nwin, total)
+    chars, dst = _dict_str_chars(geom, dictmat, dict_lens, idx, jvalid)
+    return Column(T.string, chars, dst, jvalid)
+
+
 def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
     """All row groups of one column via the device path; None → fall back."""
     parts = []
@@ -340,16 +577,21 @@ def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
     is_flba = phys == D.PT_FIXED_LEN_BYTE_ARRAY
     if is_flba and not dt.is_decimal:
         return None   # non-decimal fixed-size binary (UUIDs): host path
-    if kind == "plain_str" and dt.id != T.TypeId.STRING:
+    if kind in ("plain_str", "dict_str") and dt.id != T.TypeId.STRING:
         return None   # BYTE_ARRAY decimals etc.: host path
 
-    valid_np = None
-    if any(p[4] is not None for p in parts):
-        valid_np = np.concatenate(
-            [p[4] if p[4] is not None else np.ones(p[5], bool)
-             for p in parts])
-    jvalid = None if valid_np is None else jnp.asarray(valid_np)
     n_total = int(sum(p[5] for p in parts))
+    if kind == "plain_str":
+        # the native offsets walker scatters by validity on HOST — np mask
+        valid_np = _valid_np_concat(parts)
+        jvalid = None if valid_np is None else jnp.asarray(valid_np)
+    else:
+        # def levels expand ON DEVICE (bit test over the run plans)
+        valid_np = None
+        jvalid = _valid_device_concat(parts)
+
+    if kind == "dict_str":
+        return _scan_dict_str(parts, jvalid, n_total)
 
     if kind == "plain_str":
         # strings fully on device: the char bytes never round through a
@@ -400,8 +642,16 @@ def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
         return Column(T.string, chars, joffs, jvalid)
 
     if kind == "plain_bool":
-        npresent = [p[5] if p[4] is None else int(p[4].sum())
-                    for p in parts]
+        def _npres(p):
+            v = p[4]
+            if v is None:
+                return p[5]
+            if isinstance(v, np.ndarray):
+                return int(v.sum())
+            from . import rle_device as RLE
+            return sum(k if plan is None else RLE.present_count(plan, 1)
+                       for plan, k in v[1])
+        npresent = [_npres(p) for p in parts]
         if len(parts) > 1 and any(k % 8 for k in npresent[:-1]):
             return None   # bit-misaligned chunk boundary: host path
         payload = b"".join(p[3] for p in parts)
@@ -422,18 +672,23 @@ def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
         base = dicts[0]
         if any(d is not base and not np.array_equal(d, base)
                for d in dicts[1:]):
-            # per-row-group dictionaries differ: rebase indices
+            # per-row-group dictionaries differ: rebase indices (the
+            # per-chunk run plans expand on device, offset added there)
             idx_all = []
             offset = 0
             merged = np.concatenate(dicts)
             for p in parts:
-                idx_all.append(p[3] + offset)
+                part_idx = _idx_device_concat(p[3])
+                idx_all.append(part_idx + offset if offset else part_idx)
                 offset += p[2].shape[0]
             dict_dev = _upload_dict(phys, merged)
-            idx = jnp.asarray(np.concatenate(idx_all))
+            idx = jnp.concatenate(idx_all) if len(idx_all) > 1 \
+                else idx_all[0]
         else:
             dict_dev = _upload_dict(phys, base)
-            idx = jnp.asarray(np.concatenate([p[3] for p in parts]))
+            idx_all = [_idx_device_concat(p[3]) for p in parts]
+            idx = jnp.concatenate(idx_all) if len(idx_all) > 1 \
+                else idx_all[0]
         data = _device_dict(phys, dict_dev, idx, jvalid)
     if is_flba:
         # decimal narrowing mirrors the host path: lo limb for ≤18 digits

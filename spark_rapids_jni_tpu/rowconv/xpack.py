@@ -312,6 +312,32 @@ def plan_segmented_gather(src_starts_np: np.ndarray, lens_np: np.ndarray,
     return (n, g, B, Lw, Bd, int(P), nwin, total)
 
 
+def dst_combine_stats(dst_offs: jnp.ndarray, g: int = 8):
+    """Traceable destination-side packing stats for the group-accumulate
+    + window combine: [total, max group dst span, max groups per 512B
+    window].  Shared by every engine that packs ordered segments
+    (segmented_gather, the from_rows inverse, dictionary strings)."""
+    n = dst_offs.shape[0] - 1
+    dst = dst_offs.astype(jnp.int64)
+    ngroups = -(-n // g)
+    gi = jnp.minimum(jnp.arange(ngroups + 1) * g, n)
+    dstg = dst[gi]
+    dspan = jnp.max(dstg[1:] - dstg[:-1])
+    upto = jnp.searchsorted(dstg[:-1], dstg[:-1] + 512, side="left")
+    max_p = jnp.max(upto - jnp.arange(ngroups)) + 1
+    return jnp.stack([dst[-1], dspan, max_p])
+
+
+def plan_combine(total: int, dspan: int, max_p: int, reject_tag: str):
+    """Bucket the combine geometry (Bd, P, nwin) from destination stats;
+    None (with fallback accounting) outside the caps."""
+    Bd = _bucket(-(-max(dspan, 1) // 4) + 1, 8)
+    P = _bucket(max_p, 2)
+    if Bd > 512 or P > 64:
+        return _reject(reject_tag, Bd=Bd, P=int(P))
+    return (Bd, int(P), -(-total // 512))
+
+
 @jax.jit
 def _seg_gather_stats(src_starts, lens, dst_offs):
     """Device geometry stats for :func:`plan_from_device_stats`: ONE tiny
@@ -719,11 +745,13 @@ def _plan_from_rows_cols(stats: np.ndarray):
         if total >= (1 << 31):
             return _reject("from_rows_total", col=vi, total=total)
         Lw = _bucket(-(-max(lmax, 1) // 4) + 1, 4)
-        Bd = _bucket(-(-max(dspan, 1) // 4) + 1, 8)
-        P = _bucket(max_p, 2)
-        if Lw > 512 or Bd > 512 or P > 64:
-            return _reject("from_rows_col_caps", col=vi, Lw=Lw, Bd=Bd, P=P)
-        colgeo.append((Lw, Bd, int(P), -(-total // 512), total))
+        if Lw > 512:
+            return _reject("from_rows_col_caps", col=vi, Lw=Lw)
+        combine = plan_combine(total, dspan, max_p, "from_rows_col_caps")
+        if combine is None:
+            return None
+        Bd, P, nwin = combine
+        colgeo.append((Lw, Bd, P, nwin, total))
     return tuple(colgeo)
 
 

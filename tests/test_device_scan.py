@@ -229,3 +229,137 @@ def test_device_scan_strings_not_fallback(monkeypatch):
     monkeypatch.setattr(device_scan.D, "read_table", boom)
     dev = device_scan.scan_table(raw)
     assert dev.columns[0].to_pylist()[:3] == ["name-0", "name-1", "name-2"]
+
+
+# ---- dictionary strings + device RLE (round 5) -----------------------------
+
+def _str_cols_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a.data), np.asarray(b.data))
+    np.testing.assert_array_equal(np.asarray(a.offsets),
+                                  np.asarray(b.offsets))
+    np.testing.assert_array_equal(np.asarray(a.validity_or_true()),
+                                  np.asarray(b.validity_or_true()))
+
+
+@pytest.mark.parametrize("compression", ["NONE", "SNAPPY"])
+@pytest.mark.parametrize("with_nulls", [False, True])
+def test_dict_strings_on_device(compression, with_nulls):
+    """Dictionary-encoded strings — the dominant real-world string
+    encoding — must decode byte-exactly through the device path."""
+    n = 5000
+    words = ["", "tpu", "spark-rapids", "a-much-longer-dictionary-entry",
+             "x" * 95, "payload", "ünïcodé-bytes"]
+    vals = [words[i] for i in RNG.integers(0, len(words), n)]
+    if with_nulls:
+        vals = [None if RNG.random() < 0.15 else v for v in vals]
+    t = pa.table({"s": pa.array(vals, pa.string()),
+                  "k": pa.array(RNG.integers(0, 50, n), pa.int64())})
+    raw = write(t, compression=compression, use_dictionary=True,
+                row_group_size=1800)        # multiple chunks
+    dev = device_scan.scan_table(raw)
+    host = decode.read_table(raw)
+    _str_cols_equal(dev.columns[0], host.columns[0])
+    np.testing.assert_array_equal(np.asarray(dev.columns[1].data),
+                                  np.asarray(host.columns[1].data))
+
+
+def test_dict_strings_not_fallback(monkeypatch):
+    """Prove dictionary strings decode on the DEVICE path (no host column
+    decoder involvement)."""
+    n = 3000
+    t = pa.table({"s": pa.array([f"name-{i % 37}" for i in range(n)])})
+    raw = write(t, use_dictionary=True)
+
+    def boom(*a, **k):
+        raise AssertionError("host column decode reached")
+    monkeypatch.setattr(device_scan.D, "read_table", boom)
+    dev = device_scan.scan_table(raw)
+    assert dev.columns[0].to_pylist()[:3] == ["name-0", "name-1", "name-2"]
+
+
+def test_dict_indices_expand_on_device(monkeypatch):
+    """The dictionary-index RLE stream must expand on device: poison the
+    host hybrid decoder and scan a dict-encoded fixed-width column."""
+    n = 4096
+    t = pa.table({"v": pa.array(RNG.integers(0, 200, n), pa.int32())})
+    raw = write(t, use_dictionary=True)
+    host = decode.read_table(raw)          # oracle BEFORE the poison
+
+    def boom(*a, **k):
+        raise AssertionError("host RLE decode reached")
+    monkeypatch.setattr(device_scan.D, "decode_rle_bitpacked_hybrid", boom)
+    dev = device_scan.scan_table(raw)
+    np.testing.assert_array_equal(np.asarray(dev.columns[0].data),
+                                  np.asarray(host.columns[0].data))
+
+
+def test_def_levels_expand_on_device(monkeypatch):
+    """Nullable fixed-width columns: the def-level stream expands on
+    device too (run headers walked on host, payload bit-tested on chip)."""
+    n = 3000
+    vals = [None if RNG.random() < 0.2 else int(v)
+            for v in RNG.integers(0, 1000, n)]
+    t = pa.table({"v": pa.array(vals, pa.int64())})
+    raw = write(t, use_dictionary=False)
+    host = decode.read_table(raw)          # oracle BEFORE the poison
+
+    def boom(*a, **k):
+        raise AssertionError("host RLE decode reached")
+    monkeypatch.setattr(device_scan.D, "decode_rle_bitpacked_hybrid", boom)
+    dev = device_scan.scan_table(raw)
+    va = np.asarray(dev.columns[0].validity_or_true())
+    np.testing.assert_array_equal(
+        va, np.asarray(host.columns[0].validity_or_true()))
+    np.testing.assert_array_equal(
+        np.asarray(dev.columns[0].data)[va],
+        np.asarray(host.columns[0].data)[va])
+
+
+def test_rle_device_differential():
+    """rle_device expansion (host + device) vs the host hybrid decoder
+    across synthesized run mixes."""
+    from spark_rapids_jni_tpu.parquet import rle_device as R
+
+    def varint(v):
+        out = b""
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                out += bytes([b | 0x80])
+            else:
+                return out + bytes([b])
+
+    def bp(vals, bw):
+        g = -(-len(vals) // 8)
+        vals = list(vals) + [0] * (g * 8 - len(vals))
+        bits = []
+        for v in vals:
+            bits += [(v >> i) & 1 for i in range(bw)]
+        by = np.packbits(np.array(bits, np.uint8),
+                         bitorder="little").tobytes()
+        return varint((g << 1) | 1) + by
+
+    def rle(val, cnt, bw):
+        return varint(cnt << 1) + int(val).to_bytes((bw + 7) // 8,
+                                                    "little")
+
+    rng = np.random.default_rng(5)
+    for bw in (1, 3, 8, 17, 24):
+        n = 777
+        vals = rng.integers(0, 1 << bw, n)
+        buf = bp(vals, bw)
+        plan = R.parse_runs(buf, bw, n)
+        want = decode.decode_rle_bitpacked_hybrid(buf, bw, n)
+        np.testing.assert_array_equal(R.expand_np(plan), want)
+        np.testing.assert_array_equal(np.asarray(R.expand_device(plan)),
+                                      want.astype(np.int32))
+    # mixed runs + bucketed-R padding path
+    buf = rle(2, 100, 3) + bp(rng.integers(0, 8, 64), 3) + rle(5, 33, 3)
+    n = 197
+    plan = R.parse_runs(buf, 3, n)
+    want = decode.decode_rle_bitpacked_hybrid(buf, 3, n)
+    np.testing.assert_array_equal(np.asarray(R.expand_device(plan)),
+                                  want.astype(np.int32))
+    # over-wide bit width → host fallback signal
+    assert R.parse_runs(b"", 25, 10) is None
