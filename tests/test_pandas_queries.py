@@ -1,0 +1,37 @@
+"""The pandas baseline plans (benchmarks/pandas_queries.py) must do the
+same WORK as the framework queries: same coverage (every QUERIES entry)
+and same result cardinality on shared data.  Exact-value correctness is
+the per-query differentials' job (test_tpcds*.py); this guards the
+baseline harness from timing a different plan."""
+
+import io
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from benchmarks import pandas_queries as PQ
+from benchmarks import tpcds_data
+from spark_rapids_jni_tpu.models import tpcds
+
+
+@pytest.fixture(scope="module")
+def data():
+    files = tpcds_data.generate(n_sales=20_000, n_items=300, seed=11)
+    dfs = {k: pd.read_parquet(io.BytesIO(v)) for k, v in files.items()}
+    tables = tpcds.load_tables(files)
+    return dfs, tables
+
+
+def test_full_coverage():
+    assert set(PQ.QUERIES) == set(tpcds.QUERIES)
+
+
+@pytest.mark.parametrize("qname", sorted(tpcds.QUERIES))
+def test_same_cardinality(data, qname):
+    dfs, tables = data
+    out_pd = PQ.QUERIES[qname](dfs)
+    out_fw = tpcds.QUERIES[qname](tables)
+    assert len(out_pd) == out_fw.num_rows, (
+        f"{qname}: pandas {len(out_pd)} rows vs framework "
+        f"{out_fw.num_rows}")

@@ -124,6 +124,9 @@ def main():
     def _crashed(exc_repr: str) -> bool:
         return "UNAVAILABLE" in exc_repr or "crashed" in exc_repr
 
+    def _transient(exc_repr: str) -> bool:
+        return "HTTP 5" in exc_repr
+
     def _reexec() -> bool:
         """Re-exec for a fresh backend; False = budget exhausted (the
         caller must STOP — the poisoned backend fails every dispatch)."""
@@ -145,11 +148,16 @@ def main():
         if prev is not None:
             done = "steady_ms" in prev or "steady_skipped" in prev
             gave_up = prev.get("crashes", 0) >= 2 or (
-                "error" in prev and not _crashed(prev["error"]))
+                "error" in prev and not _crashed(prev["error"])
+                and not _transient(prev["error"]))
             if done or gave_up:
                 continue
         fn = tpcds.QUERIES[name]
         entry = {"crashes": (prev or {}).get("crashes", 0)}
+        # transient remote-compile failures (HTTP 5xx) retry in-process;
+        # an entry whose only error is transient is also retried on resume
+        if prev and "error" in prev and "HTTP 5" in prev["error"]:
+            entry = {k: v for k, v in prev.items() if k != "error"}
         try:
             # cold: eager capture (compiles + size syncs, tape recorded)
             syncs.reset_sync_count()

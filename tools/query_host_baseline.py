@@ -1,10 +1,12 @@
 #!/usr/bin/env python
-"""Pandas host baseline for the SF1-class query slice → HOST_QUERY_BASELINE.json.
+"""Pandas host baseline for the FULL query subset → HOST_QUERY_BASELINE.json.
 
-Times the same plans ``tools/query_bench.py`` runs on chip, executed by
-pandas over the identical parquet bytes (pyarrow reader) — the CPU
-single-node context figure for BASELINE config #3 (the north star compares
-against CPU Spark; single-process pandas is the in-image stand-in).
+Times every plan in ``benchmarks/pandas_queries.py`` (the pandas twins of
+``models/tpcds.QUERIES``, cardinality-checked against the framework in
+``tests/test_pandas_queries.py``) over the identical parquet bytes —
+the CPU single-node context figure for BASELINE config #3 (the north
+star compares against CPU Spark; single-process pandas is the in-image
+stand-in).
 
 Usage: python tools/query_host_baseline.py [n_sales] [out.json]
 """
@@ -14,7 +16,6 @@ import json
 import sys
 import time
 
-import numpy as np
 import pandas as pd
 
 sys.path.insert(0, ".")
@@ -25,6 +26,7 @@ RESULTS = {"queries": {}}
 def main():
     n_sales = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
     out = sys.argv[2] if len(sys.argv) > 2 else "HOST_QUERY_BASELINE.json"
+    from benchmarks import pandas_queries as PQ
     from benchmarks import tpcds_data
     files = tpcds_data.generate(n_sales=n_sales, n_items=20_000,
                                 n_stores=50, seed=5)
@@ -32,56 +34,29 @@ def main():
     dfs = {k: pd.read_parquet(io.BytesIO(v)) for k, v in files.items()}
     RESULTS["n_sales"] = n_sales
     RESULTS["load_s"] = round(time.perf_counter() - t0, 1)
-    ss, item, dd, store = (dfs["store_sales"], dfs["item"],
-                           dfs["date_dim"], dfs["store"])
+    print(f"pandas load: {RESULTS['load_s']}s", flush=True)
 
-    def q3():
-        mid = 436   # the framework query's default parameter
-        j = (ss.merge(item[item.i_manufact_id == mid], left_on="ss_item_sk",
-                      right_on="i_item_sk")
-             .merge(dd[dd.d_moy == 11], left_on="ss_sold_date_sk",
-                    right_on="d_date_sk"))
-        return (j.groupby(["d_year", "i_brand_id", "i_brand"],
-                          as_index=False)["ss_ext_sales_price"].sum())
+    total = 0.0
+    for name in sorted(PQ.QUERIES):
+        fn = PQ.QUERIES[name]
+        try:
+            fn(dfs)      # warm (page cache, dtypes)
+            t0 = time.perf_counter()
+            res = fn(dfs)
+            wall = time.perf_counter() - t0
+            RESULTS["queries"][name] = {"wall_s": round(wall, 3),
+                                        "rows_out": int(len(res))}
+            total += wall
+            print(f"{name}: {wall:.3f}s, {len(res)} rows", flush=True)
+        except Exception as e:  # noqa: BLE001 — record, keep going
+            RESULTS["queries"][name] = {"error": repr(e)[:200]}
+            print(f"{name}: ERROR {e!r}", flush=True)
+        with open(out, "w") as f:
+            json.dump(RESULTS, f, indent=1)
 
-    def q55():
-        mid = 28
-        j = ss.merge(item[item.i_manager_id == mid], left_on="ss_item_sk",
-                     right_on="i_item_sk")
-        return (j.groupby(["i_brand_id", "i_brand"], as_index=False)
-                ["ss_ext_sales_price"].sum())
-
-    def q62():
-        ssf = ss[(ss.ss_quantity >= 10) & (ss.ss_quantity <= 60)]
-        j = ssf.merge(dd[dd.d_year == 2000], left_on="ss_sold_date_sk",
-                      right_on="d_date_sk")
-        return j.groupby("d_moy", as_index=False)["ss_quantity"].count()
-
-    def q_state_rollup():
-        sf = store[store.s_state == "TN"]
-        j = ss.merge(sf, left_on="ss_store_sk", right_on="s_store_sk")
-        return (j.groupby("s_state", as_index=False)
-                .agg(s=("ss_sales_price_cents", "sum"),
-                     m=("ss_quantity", "mean"),
-                     c=("ss_quantity", "count")))
-
-    def q_having():
-        j = ss.merge(item, left_on="ss_item_sk", right_on="i_item_sk")
-        rev = (j.groupby("i_brand_id", as_index=False)
-               ["ss_ext_sales_price"].sum())
-        return rev[rev.ss_ext_sales_price > 1000.0]
-
-    for name, fn in [("q3", q3), ("q55", q55), ("q62", q62),
-                     ("q_state_rollup", q_state_rollup),
-                     ("q_having", q_having)]:
-        fn()      # warm (page cache, dtypes)
-        t0 = time.perf_counter()
-        res = fn()
-        wall = time.perf_counter() - t0
-        RESULTS["queries"][name] = {"wall_s": round(wall, 2),
-                                    "rows_out": int(len(res))}
-        print(f"{name}: {wall:.2f}s, {len(res)} rows", flush=True)
-
+    RESULTS["subset_total_s"] = round(total, 2)
+    print(f"pandas subset total ({len(PQ.QUERIES)} queries): "
+          f"{total:.2f}s", flush=True)
     with open(out, "w") as f:
         json.dump(RESULTS, f, indent=1)
     print("wrote", out, flush=True)
