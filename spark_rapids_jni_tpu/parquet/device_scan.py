@@ -428,8 +428,9 @@ def _idx_device_concat(entries) -> jnp.ndarray:
          for e in entries]).astype(np.int32))
 
 
-@jax.jit
-def _dict_str_rows(dict_lens: jnp.ndarray, idx: jnp.ndarray, valid):
+@functools.partial(jax.jit, static_argnums=(3,))
+def _dict_str_rows(dict_lens: jnp.ndarray, idx: jnp.ndarray, valid,
+                   g: int = 8):
     """Per-output-row dictionary entry + chars length (def-level expanded)
     and the packing stats — shared by the planning sync and the chars
     program so the two cannot drift."""
@@ -444,7 +445,7 @@ def _dict_str_rows(dict_lens: jnp.ndarray, idx: jnp.ndarray, valid):
         lens_row = jnp.where(valid, dict_lens[idx_full], 0).astype(
             jnp.int32)
     dst = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(lens_row)])
-    return idx_full, lens_row, dst, xpack.dst_combine_stats(dst)
+    return idx_full, lens_row, dst, xpack.dst_combine_stats(dst, g)
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
@@ -454,10 +455,10 @@ def _dict_str_chars(geom, dictmat: jnp.ndarray, dict_lens: jnp.ndarray,
     per output row, then packed to the Arrow chars stream + offsets with
     the xpack combine — all on device, one program."""
     from ..rowconv import xpack
-    n, Bd, P, nwin, total = geom
-    idx_full, lens_row, dst, _ = _dict_str_rows(dict_lens, idx, valid)
+    n, g, Bd, P, nwin, total = geom
+    idx_full, lens_row, dst, _ = _dict_str_rows(dict_lens, idx, valid, g)
     piece = dictmat[idx_full]                       # [n, Lw] u32 rows
-    chars = xpack._combine_to_stream(piece, lens_row, dst, n, 8, Bd, P,
+    chars = xpack._combine_to_stream(piece, lens_row, dst, n, g, Bd, P,
                                      nwin, total)
     return chars, dst
 
@@ -540,19 +541,28 @@ def _scan_dict_str(parts, jvalid, n_total: int) -> Optional[Column]:
         chars_dict, jnp.asarray(dict_offs.astype(np.int32)), Ds, g, B, Lw)
     dict_lens = jnp.asarray(lens)
 
-    # packing geometry: ONE stacked sync (row lens live on device)
-    stats = np.asarray(_dict_str_rows(dict_lens, idx, jvalid)[3])
-    total, dspan, max_p = (int(x) for x in stats)
-    if total >= 2**31:
+    # packing geometry: one stacked sync per adaptive-g try (short dict
+    # entries need LARGE groups or the window combine's P cap blows —
+    # same adaptation as xpack.plan_from_rows)
+    gs = (8, 32, 128)
+    geom = None
+    for g in gs:
+        stats = np.asarray(_dict_str_rows(dict_lens, idx, jvalid, g)[3])
+        total, dspan, max_p = (int(x) for x in stats)
+        if total >= 2**31:
+            return None
+        if total == 0:
+            offs32 = jnp.zeros(n_total + 1, jnp.int32)
+            return Column(T.string, jnp.zeros(0, jnp.uint8), offs32,
+                          jvalid)
+        combine = xpack.plan_combine(total, dspan, max_p, "dict_str_caps",
+                                     final=(g == gs[-1]))
+        if combine is not None:
+            Bd, P, nwin = combine
+            geom = (n_total, g, Bd, P, nwin, total)
+            break
+    if geom is None:
         return None
-    if total == 0:
-        offs32 = jnp.zeros(n_total + 1, jnp.int32)
-        return Column(T.string, jnp.zeros(0, jnp.uint8), offs32, jvalid)
-    combine = xpack.plan_combine(total, dspan, max_p, "dict_str_caps")
-    if combine is None:
-        return None
-    Bd, P, nwin = combine
-    geom = (n_total, Bd, P, nwin, total)
     chars, dst = _dict_str_chars(geom, dictmat, dict_lens, idx, jvalid)
     return Column(T.string, chars, dst, jvalid)
 

@@ -328,13 +328,17 @@ def dst_combine_stats(dst_offs: jnp.ndarray, g: int = 8):
     return jnp.stack([dst[-1], dspan, max_p])
 
 
-def plan_combine(total: int, dspan: int, max_p: int, reject_tag: str):
+def plan_combine(total: int, dspan: int, max_p: int, reject_tag: str,
+                 final: bool = True):
     """Bucket the combine geometry (Bd, P, nwin) from destination stats;
-    None (with fallback accounting) outside the caps."""
+    None outside the caps (with fallback accounting only when ``final`` —
+    adaptive-g retries probe several group sizes before giving up)."""
     Bd = _bucket(-(-max(dspan, 1) // 4) + 1, 8)
     P = _bucket(max_p, 2)
     if Bd > 512 or P > 64:
-        return _reject(reject_tag, Bd=Bd, P=int(P))
+        if final:
+            return _reject(reject_tag, Bd=Bd, P=int(P))
+        return None
     return (Bd, int(P), -(-total // 512))
 
 
@@ -717,11 +721,15 @@ def _from_rows_x_jit(layout: RowLayout, geom, words, offs):
     return datas, valid, tuple(chars), tuple(out_offs)
 
 
-def _plan_from_rows_a(n: int, offs_np: np.ndarray):
+def _plan_from_rows_a(n: int, offs_np: np.ndarray, g: int = 8):
     """Row-extraction geometry (n, Mw, g, Bw) from the host row offsets
     alone — needed before the stats program can run.  None (with fallback
-    accounting) outside the buckets."""
-    g = 8
+    accounting) outside the buckets.
+
+    ``g`` (rows per slab-gather group) adapts to the geometry: short rows
+    with tiny char spans need LARGE groups, or ~``512/span`` groups
+    overlap each 512B output window and the combine's P-unrolled loop
+    blows its cap (the mostly-empty-strings shape)."""
     row_sizes = offs_np[1:] - offs_np[:-1]
     Mw = _bucket(-(-int(row_sizes.max(initial=8)) // 4), 8)
     if Mw > 256:                                  # > 1KB rows
@@ -734,7 +742,7 @@ def _plan_from_rows_a(n: int, offs_np: np.ndarray):
     return (n, Mw, g, Bw)
 
 
-def _plan_from_rows_cols(stats: np.ndarray):
+def _plan_from_rows_cols(stats: np.ndarray, final: bool = True):
     """Per-column packing geometry from the device stats sync, or None."""
     colgeo = []
     for vi in range(stats.shape[0]):
@@ -742,12 +750,15 @@ def _plan_from_rows_cols(stats: np.ndarray):
         if total == 0:
             colgeo.append((0, 0, 0, 0, 0))
             continue
+        # g-invariant caps reject immediately (retrying with a larger
+        # group size cannot change the total or the entry length)
         if total >= (1 << 31):
             return _reject("from_rows_total", col=vi, total=total)
         Lw = _bucket(-(-max(lmax, 1) // 4) + 1, 4)
         if Lw > 512:
             return _reject("from_rows_col_caps", col=vi, Lw=Lw)
-        combine = plan_combine(total, dspan, max_p, "from_rows_col_caps")
+        combine = plan_combine(total, dspan, max_p, "from_rows_col_caps",
+                               final)
         if combine is None:
             return None
         Bd, P, nwin = combine
@@ -782,15 +793,24 @@ def plan_from_rows(layout: RowLayout, batch, words: jnp.ndarray):
     tag = f"xunpack_geom:{hash(layout)}"
     geom = syncs.memo_get(tag, [batch.data, batch.offsets])
     if geom is None:
-        geom_a = _plan_from_rows_a(n, offs_np)
-        if geom_a is not None:
+        gs = (8, 32, 128)
+        for trial, g in enumerate(gs):
+            geom_a = _plan_from_rows_a(n, offs_np, g)
+            if geom_a is None:
+                break                      # Bw only grows with g: give up
             stats = np.asarray(_from_rows_x_stats(
-                layout, geom_a, words, batch.offsets))       # ONE sync
-            if stats[:, 1].any():
+                layout, geom_a, words, batch.offsets))   # one sync per try
+            if trial == 0 and stats[:, 1].any():
                 raise ValueError(
                     "corrupt row data: string slot outside its row")
-            colgeo = _plan_from_rows_cols(stats)
-            geom = None if colgeo is None else geom_a + (colgeo,)
+            colgeo = _plan_from_rows_cols(stats, final=(g == gs[-1]))
+            if colgeo is not None:
+                geom = geom_a + (colgeo,)
+                break
+            if any(int(r[0]) >= (1 << 31)
+                   or _bucket(-(-max(int(r[2]), 1) // 4) + 1, 4) > 512
+                   for r in stats):
+                break          # g-invariant rejection: retries cannot help
         # rejections memoize too (as "reject"): a repeat conversion of an
         # out-of-cap batch must not re-run the stats program + sync, nor
         # re-increment the fallback counters, on every call

@@ -363,3 +363,15 @@ def test_rle_device_differential():
                                   want.astype(np.int32))
     # over-wide bit width → host fallback signal
     assert R.parse_runs(b"", 25, 10) is None
+
+
+def test_dict_strings_mostly_empty():
+    """Short/empty dictionary entries: the adaptive group size must keep
+    the device path engaged (round-5 regression: g=8 blew the P cap)."""
+    n = 4000
+    vals = ["" if i % 3 else "ab" for i in range(n)]
+    t = pa.table({"s": pa.array(vals, pa.string())})
+    raw = write(t, use_dictionary=True)
+    dev = device_scan.scan_table(raw)
+    host = decode.read_table(raw)
+    _str_cols_equal(dev.columns[0], host.columns[0])

@@ -156,7 +156,7 @@ def main():
         entry = {"crashes": (prev or {}).get("crashes", 0)}
         # transient remote-compile failures (HTTP 5xx) retry in-process;
         # an entry whose only error is transient is also retried on resume
-        if prev and "error" in prev and "HTTP 5" in prev["error"]:
+        if prev and "error" in prev and _transient(prev["error"]):
             entry = {k: v for k, v in prev.items() if k != "error"}
         try:
             # cold: eager capture (compiles + size syncs, tape recorded)

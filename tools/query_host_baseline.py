@@ -54,8 +54,10 @@ def main():
         with open(out, "w") as f:
             json.dump(RESULTS, f, indent=1)
 
+    n_ok = sum(1 for e in RESULTS["queries"].values() if "wall_s" in e)
     RESULTS["subset_total_s"] = round(total, 2)
-    print(f"pandas subset total ({len(PQ.QUERIES)} queries): "
+    RESULTS["subset_queries_ok"] = n_ok
+    print(f"pandas subset total ({n_ok}/{len(PQ.QUERIES)} queries): "
           f"{total:.2f}s", flush=True)
     with open(out, "w") as f:
         json.dump(RESULTS, f, indent=1)

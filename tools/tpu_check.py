@@ -167,6 +167,106 @@ def check_strings_large_n():
     record("strings from_rows large-n == small-n", ok)
 
 
+def check_xpack_engines():
+    """Round-5 engines on the real chip: the fused to_rows/from_rows xpack
+    programs (prove they ENGAGE, then byte-compare vs the non-xpack path),
+    segmented_gather, and cap-boundary geometries incl. empty strings and
+    an Lw outlier."""
+    import os
+    from spark_rapids_jni_tpu.rowconv import xpack
+    rng = np.random.default_rng(7)
+    cases = [
+        ("bench_shape", 4000, lambda i: ["", "tpu", "spark-rapids",
+                                         "columnar row transcode",
+                                         "x" * 24, "payload"][i % 6]),
+        ("empty_heavy", 2000, lambda i: "" if i % 3 else "ab"),
+        ("outlier", 1500, lambda i: "z" * 300 if i == 700 else "s" * (i % 9)),
+    ]
+    for name, n, gen in cases:
+        strs = [gen(i) for i in range(n)]
+        t = Table([
+            Column.from_numpy(rng.integers(-99, 99, n).astype(np.int64),
+                              sr.int64, rng.random(n) < 0.9),
+            Column.strings_from_list(strs),
+            Column.strings_from_list([s[::-1] for s in strs]),
+        ])
+        layout = compute_row_layout(t.schema)
+        b = convert_to_rows(t)[0]
+        res = xpack.from_rows_var_x(layout, b)
+        record(f"xpack from_rows engages [{name}]", res is not None)
+        got = convert_from_rows(b, t.schema)
+        saved = os.environ.get("SRJT_XPACK")
+        os.environ["SRJT_XPACK"] = "0"
+        try:
+            want_b = convert_to_rows(t)[0]
+            want = convert_from_rows(want_b, t.schema)
+        finally:
+            if saved is None:
+                del os.environ["SRJT_XPACK"]
+            else:
+                os.environ["SRJT_XPACK"] = saved
+        record(f"xpack to_rows bytes [{name}]",
+               np.array_equal(b.host_bytes(), want_b.host_bytes()))
+        ok = True
+        for ca, cb in zip(got.columns, want.columns):
+            ok = ok and np.array_equal(np.asarray(ca.data),
+                                       np.asarray(cb.data))
+            if ca.offsets is not None:
+                ok = ok and np.array_equal(np.asarray(ca.offsets),
+                                           np.asarray(cb.offsets))
+        record(f"xpack from_rows columns [{name}]", ok)
+
+    # segmented_gather: ordered segments with gaps, vs numpy
+    S = 200_000
+    src_b = rng.integers(0, 256, S).astype(np.uint8)
+    nseg = 3000
+    lens = rng.integers(0, 90, nseg).astype(np.int32)
+    gaps = rng.integers(0, 8, nseg)
+    starts = np.zeros(nseg, np.int64)
+    p = 0
+    for i in range(nseg):
+        starts[i] = p
+        p += lens[i] + gaps[i]
+    dst = np.zeros(nseg + 1, np.int64)
+    np.cumsum(lens, out=dst[1:])
+    geom = xpack.plan_segmented_gather(starts, lens, dst)
+    record("segmented_gather plans", geom is not None)
+    if geom is not None:
+        got = np.asarray(xpack.segmented_gather(
+            geom, jnp.asarray(src_b), jnp.asarray(starts.astype(np.int32)),
+            jnp.asarray(lens), jnp.asarray(dst.astype(np.int32))))
+        want = np.concatenate(
+            [src_b[s:s + l] for s, l in zip(starts, lens)])             if lens.sum() else np.zeros(0, np.uint8)
+        record("segmented_gather bytes", np.array_equal(got, want))
+
+
+def check_dict_strings():
+    """Dictionary-string device decode (round 5) byte-exact on chip vs the
+    host decoder, nulls included."""
+    import io
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from spark_rapids_jni_tpu.parquet import decode, device_scan
+    rng = np.random.default_rng(9)
+    n = 30_000
+    words = ["", "tpu", "dictionary-entry-payload", "x" * 60, "ünïcodé"]
+    vals = [None if rng.random() < 0.1 else words[i]
+            for i in rng.integers(0, len(words), n)]
+    t = pa.table({"s": pa.array(vals, pa.string())})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, compression="SNAPPY", use_dictionary=True,
+                   row_group_size=12_000)
+    raw = buf.getvalue()
+    dev = device_scan.scan_table(raw).columns[0]
+    host = decode.read_table(raw).columns[0]
+    ok = (np.array_equal(np.asarray(dev.data), np.asarray(host.data))
+          and np.array_equal(np.asarray(dev.offsets),
+                             np.asarray(host.offsets))
+          and np.array_equal(np.asarray(dev.validity_or_true()),
+                             np.asarray(host.validity_or_true())))
+    record("dict strings device decode", ok)
+
+
 def check_fixed_words():
     rng = np.random.default_rng(2)
     for name, schema in SCHEMAS.items():
@@ -249,6 +349,10 @@ def main():
         check_strings_transcode()
         print("strings large-n branch:", flush=True)
         check_strings_large_n()
+        print("xpack engines (round 5):", flush=True)
+        check_xpack_engines()
+        print("dict strings:", flush=True)
+        check_dict_strings()
         print("fixed-width u32-words transcode:", flush=True)
         check_fixed_words()
         print("f64 bits<->values:", flush=True)
