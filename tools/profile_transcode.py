@@ -233,6 +233,77 @@ def main():
     measure("u8_to_u32", ragged.u8_to_u32, b8, 2 * nb8)
     measure("u32_to_u8", ragged.u32_to_u8, w32, 2 * nb8)
 
+    # 5b. fixed-path compose breakdown (VERDICT r5 task: which stage of
+    # _to_rows_fixed_words eats the gap between the 343 GB/s interleave
+    # ceiling and the ~30 GB/s public path?)
+    import bench as bench_mod_
+    from spark_rapids_jni_tpu.rowconv import convert as cv
+    from spark_rapids_jni_tpu.rowconv.layout import compute_row_layout
+    tbl_c = bench_mod_.build_table(1_000_000, 12)
+    lay_c = compute_row_layout(tbl_c.schema)
+    Wc = lay_c.fixed_row_size // 4
+    nrows = tbl_c.num_rows
+    datas_c = tuple(c.data for c in tbl_c.columns)
+    valid_c = jnp.stack([c.validity_or_true() for c in tbl_c.columns],
+                        axis=1)
+    row_bytes_c = nrows * lay_c.fixed_row_size
+
+    def stage_only(a):
+        ds = a
+        return tuple(cv._stage_column_dt(d, dt)
+                     for d, dt in zip(ds, lay_c.schema))
+    measure("fx_stage_columns", stage_only, datas_c, row_bytes_c,
+            f"per-column bitcast staging, W={Wc}")
+
+    def vbytes_only(a):
+        v = a
+        outs = []
+        for k in range(lay_c.validity_bytes):
+            acc = jnp.zeros((nrows,), jnp.uint32)
+            for i in range(min(8, lay_c.num_columns - k * 8)):
+                acc = acc | (v[:, k * 8 + i].astype(jnp.uint32)
+                             << jnp.uint32(i))
+            outs.append(acc)
+        return tuple(outs)
+    measure("fx_validity_bytes", vbytes_only, valid_c, nrows * 2)
+
+    staged_pre = tuple(cv._stage_column_dt(d, dt)
+                       for d, dt in zip(datas_c, lay_c.schema))
+
+    def compose_only(a):
+        st = a
+        plan = cv._word_plan(lay_c)
+        words = []
+        for w in range(Wc):
+            acc = None
+            for ii, kind, arg in plan[w]:
+                if kind == "vbyte":
+                    continue
+                x = st[ii]
+                v = (x if kind == "full"
+                     else x[:, arg] if kind == "pair"
+                     else x << jnp.uint32(arg * 8))
+                acc = v if acc is None else acc | v
+            words.append(acc if acc is not None
+                         else jnp.zeros((nrows,), jnp.uint32))
+        return tuple(words)
+    measure("fx_compose_words", compose_only, staged_pre, row_bytes_c,
+            "from pre-staged arrays (no bitcasts)")
+
+    def whole_words(a):
+        ds, v = a
+        return cv._to_rows_fixed_words(lay_c, ds, v)
+    measure("fx_to_rows_words_full", whole_words, (datas_c, valid_c),
+            row_bytes_c, "stage+compose+interleave")
+
+    from spark_rapids_jni_tpu import convert_to_rows as _ctr
+    b0_c = _ctr(tbl_c)[0]
+
+    def decode_words(a):
+        return cv._from_rows_fixed_words(lay_c, a)
+    measure("fx_from_rows_words_full", decode_words, b0_c.data,
+            row_bytes_c, "deinterleave+decode")
+
     # 6. current public path at the bench schema
     import bench as bench_mod
     table = bench_mod.build_table(1_000_000, 12)

@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Per-stage profile of the fused var-width engines → PROFILE_XPACK.json.
+
+Times each stage of the to_rows xpack program (and the from_rows inverse)
+in isolation at the bench geometry, with the same chained-fori-loop
+differencing as bench.py, so the cost center is measurable instead of
+guessed (VERDICT r4: the 12-col to_rows axis sits at ~0.64 GB/s against a
+1 GB/s bar — which stage eats the 191 ms?).
+
+Stages (to_rows):
+  fixed_region   — _var_fixed_region + u8→u32 (dense fixed matrix)
+  extract        — per-column extract_group_windows (char windows)
+  place          — per-column funnel + _place_words + mask + OR into dense
+  pack           — pack_windows (output window combine)
+  full           — the whole _to_rows_x_jit (sanity: ≈ sum of stages)
+
+Usage: python tools/profile_xpack.py [out.json]
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+RESULTS = {"stages": []}
+
+
+def _chained(body, data, lo=2, hi=8, reps=2):
+    @jax.jit
+    def run(d, iters):
+        def step(_, carry):
+            acc, dd = carry
+            din = jax.lax.optimization_barrier((dd, acc))[0]
+            out = body(din)
+            out = jax.lax.optimization_barrier(out)
+            leaves = [l for l in jax.tree_util.tree_leaves(out) if l.size]
+            probe = (jax.lax.convert_element_type(
+                jnp.ravel(leaves[0])[0], jnp.int32)
+                if leaves else jnp.int32(0))
+            return (acc + probe) % jnp.int32(65521), dd
+        acc, _ = jax.lax.fori_loop(0, iters, step, (jnp.int32(0), d))
+        return acc
+
+    np.asarray(run(data, lo))
+    best = None
+    for _ in range(reps + 2):
+        t0 = time.perf_counter()
+        np.asarray(run(data, lo))
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        np.asarray(run(data, hi))
+        t_hi = time.perf_counter() - t0
+        per = (t_hi - t_lo) / (hi - lo)
+        if per > 0:
+            best = per if best is None else min(best, per)
+    return best
+
+
+def record(name, per_s, nbytes, note=""):
+    e = {"name": name, "per_iter_ms": round(per_s * 1e3, 2),
+         "gbps": round(nbytes / per_s / 1e9, 3), "note": note}
+    RESULTS["stages"].append(e)
+    print(f"  {name}: {e['per_iter_ms']} ms  {e['gbps']} GB/s  {note}",
+          flush=True)
+
+
+def main():
+    out = sys.argv[1] if len(sys.argv) > 1 else "PROFILE_XPACK.json"
+    import bench
+    from spark_rapids_jni_tpu.rowconv import xpack
+    from spark_rapids_jni_tpu.rowconv.convert import _var_fixed_region
+    from spark_rapids_jni_tpu.rowconv.layout import (
+        compute_row_layout, row_sizes_with_strings, build_batches,
+        MAX_BATCH_BYTES)
+    from spark_rapids_jni_tpu.utils import hostcache
+
+    RESULTS["backend"] = jax.default_backend()
+    print("backend:", RESULTS["backend"], flush=True)
+
+    table = bench.build_table(1_000_000, 12, string_every=3)
+    layout = compute_row_layout(table.schema)
+    n = table.num_rows
+    var_idx = layout.variable_column_indices
+    col_offs = [hostcache.host_i64(table[ci].offsets) for ci in var_idx]
+    total_lens = np.zeros(n, dtype=np.int64)
+    for o in col_offs:
+        total_lens += o[1:] - o[:-1]
+    batches = build_batches(row_sizes_with_strings(layout, total_lens),
+                            MAX_BATCH_BYTES)
+    offs_np = batches.row_offsets_within_batch[0]
+    geom = xpack._plan_geometry(layout, n, offs_np, col_offs)
+    assert geom is not None
+    n_, Mw, P, nwin, total_w, g, colgeo = geom
+    total_b = total_w * 4
+    RESULTS["geom"] = {"n": n, "Mw": Mw, "P": P, "nwin": nwin,
+                       "total_mb": round(total_b / 1e6, 1), "g": g,
+                       "colgeo": [list(c) for c in colgeo]}
+    print("geom:", RESULTS["geom"], flush=True)
+
+    datas = tuple(c.data for c in table.columns)
+    str_offsets = tuple(table[ci].offsets.astype(jnp.int32)
+                        for ci in var_idx)
+    valid = tuple(c.validity for c in table.columns)
+    fpv = layout.fixed_plus_validity
+    fpvw = -(-fpv // 4)
+
+    # --- stage: fixed region ---------------------------------------------
+    def fixed_stage(a):
+        ds, so, va = a
+        vmat = jnp.stack([jnp.ones((n,), jnp.bool_) if v is None else v
+                          for v in va], axis=1)
+        f2 = _var_fixed_region(layout, ds, so, vmat)
+        return xpack._u8_to_u32_rows(
+            jnp.pad(f2, ((0, 0), (0, fpvw * 4 - fpv))))
+    per = _chained(fixed_stage, (datas, str_offsets, valid))
+    record("fixed_region", per, n * fpv)
+
+    # --- stage: char window extraction (all var cols) ---------------------
+    def extract_stage(a):
+        ds, so = a
+        outs = []
+        for vi in range(len(var_idx)):
+            B, Lw = colgeo[vi]
+            if Lw == 0:
+                continue
+            outs.append(xpack.extract_group_windows(
+                ds[var_idx[vi]].reshape(-1), so[vi], n, g, B, Lw))
+        return tuple(outs)
+    per = _chained(extract_stage, (datas, str_offsets))
+    chars_total = int(sum(col_offs[vi][-1] for vi in range(len(var_idx))))
+    record("extract_windows", per, chars_total)
+
+    # --- stage: per-column place into dense -------------------------------
+    def place_stage(a):
+        ds, so = a
+        lens = jnp.stack([so[vi][1:] - so[vi][:-1]
+                          for vi in range(len(var_idx))],
+                         axis=1).astype(jnp.int32)
+        prefix = jnp.cumsum(lens, axis=1) - lens
+        dense = jnp.zeros((n, Mw), jnp.uint32)
+        for vi in range(len(var_idx)):
+            B, Lw = colgeo[vi]
+            if Lw == 0:
+                continue
+            win = xpack.extract_group_windows(
+                ds[var_idx[vi]].reshape(-1), so[vi], n, g, B, Lw)
+            start_b = fpv + prefix[:, vi]
+            a2 = jnp.pad(win, ((0, 0), (0, 1)))
+            prev = jnp.pad(win, ((0, 0), (1, 0)))
+            rb = (start_b % 4).astype(jnp.uint32)[:, None]
+            fun = a2
+            for k in (1, 2, 3):
+                v = ((a2 << jnp.uint32(8 * k))
+                     | (prev >> jnp.uint32(32 - 8 * k)))
+                fun = jnp.where(rb == k, v, fun)
+            placed = xpack._place_words(fun, start_b // 4, Mw)
+            mask = xpack._byte_mask(Mw, start_b, start_b + lens[:, vi])
+            dense = dense | (placed & mask)
+        return dense
+    per_place = _chained(place_stage, (datas, str_offsets))
+    record("extract+place", per_place, chars_total,
+           "includes extract (subtract extract_windows for place alone)")
+
+    # --- stage: pack_windows ----------------------------------------------
+    lens_np = np.stack([o[1:] - o[:-1] for o in col_offs], axis=1)
+    row_b_np = fpv + lens_np.sum(axis=1)
+    rs_w = ((row_b_np + 7) // 8 * 8) // 4
+    dst_w_np = np.concatenate([[0], np.cumsum(rs_w)]).astype(np.int32)
+    dense0 = jnp.asarray(
+        np.random.default_rng(0).integers(0, 2**32, (n, Mw),
+                                          dtype=np.uint32))
+    dst_w = jnp.asarray(dst_w_np)
+
+    def pack_stage(a):
+        d, dw = a
+        return xpack.pack_windows(d, dw, total_w, P, nwin)
+    per_pack = _chained(pack_stage, (dense0, dst_w))
+    record("pack_windows", per_pack, total_b)
+
+    # --- full program ------------------------------------------------------
+    def full(a):
+        ds, so, va = a
+        return xpack._to_rows_x_jit(layout, geom, ds, so, va)
+    per_full = _chained(full, (datas, str_offsets, valid))
+    record("full_to_rows", per_full, total_b)
+
+    # --- from_rows inverse -------------------------------------------------
+    from spark_rapids_jni_tpu import convert_to_rows
+    b = convert_to_rows(table)[0]
+    words = xpack.batch_words(b)
+    fgeom = xpack.plan_from_rows(layout, b, words)
+    if fgeom is not None:
+        fn_, fMw, fg, fBw, fcolgeo = fgeom
+        RESULTS["from_geom"] = {"Mw": fMw, "g": fg, "Bw": fBw,
+                                "colgeo": [list(c) for c in fcolgeo]}
+
+        def extract_rows_stage(a):
+            w, o = a
+            return xpack._extract_row_windows(w, o, fn_, fg, fBw, fMw)
+        per = _chained(extract_rows_stage, (words, b.offsets))
+        record("from.extract_rows", per, total_b)
+
+        def from_full(a):
+            w, o = a
+            return xpack._from_rows_x_jit(layout, fgeom, w, o)
+        per = _chained(from_full, (words, b.offsets))
+        record("from.full", per, total_b)
+
+    with open(out, "w") as f:
+        json.dump(RESULTS, f, indent=1)
+    print("wrote", out, flush=True)
+
+
+if __name__ == "__main__":
+    main()
