@@ -463,7 +463,85 @@ def _dict_str_chars(geom, dictmat: jnp.ndarray, dict_lens: jnp.ndarray,
     return chars, dst
 
 
-def _scan_dict_str(parts, jvalid, n_total: int) -> Optional[Column]:
+# --- per-file fused decode (round 5) ---------------------------------------
+#
+# The final per-column device programs join ONE jitted per-file program
+# (the libcudf analog decodes a whole row group in one kernel wave): host
+# staging + the small metadata programs (index expansion, packing stats)
+# run eagerly per column, then every column's heavy decode body inlines
+# into a single dispatch.  Builders take (statics, args) with the
+# validity's presence encoded in statics so arg tuples stay None-free.
+
+def _build_plain(statics, args):
+    phys, dt, has_valid = statics
+    raw, valid = (args[0], args[1] if has_valid else None)
+    data = _device_plain(phys, raw, valid)
+    if dt.id != T.TypeId.FLOAT64 and data.dtype != jnp.dtype(dt.storage):
+        data = data.astype(dt.storage)     # logical narrowing (date32 etc.)
+    return data
+
+
+def _build_flba(statics, args):
+    width, dt, has_valid = statics
+    raw, valid = (args[0], args[1] if has_valid else None)
+    data = _device_flba_decimal(width, raw, valid)
+    if dt.id == T.TypeId.DECIMAL128:
+        return data
+    return data[:, 0].astype(dt.storage)   # lo limb for <= 18 digits
+
+
+def _build_bool(statics, args):
+    k, has_valid = statics
+    bits, valid = (args[0], args[1] if has_valid else None)
+    return _device_bool(k, bits, valid)
+
+
+def _build_dict(statics, args):
+    phys, dt, is_flba, has_valid = statics
+    dict_dev, idx = args[0], args[1]
+    valid = args[2] if has_valid else None
+    data = _device_dict(phys, dict_dev, idx, valid)
+    if is_flba:
+        if dt.id == T.TypeId.DECIMAL128:
+            return data
+        return data[:, 0].astype(dt.storage)
+    if dt.id != T.TypeId.FLOAT64 and data.dtype != jnp.dtype(dt.storage):
+        data = data.astype(dt.storage)
+    return data
+
+
+def _build_pstr(statics, args):
+    from ..rowconv import xpack
+    (geom,) = statics
+    payload, st, ln, dst = args
+    return xpack.segmented_gather(geom, payload, st, ln, dst)
+
+
+def _build_dstr(statics, args):
+    geom, has_valid = statics
+    dictmat, dict_lens, idx = args[0], args[1], args[2]
+    valid = args[3] if has_valid else None
+    return _dict_str_chars(geom, dictmat, dict_lens, idx, valid)
+
+
+_BUILDERS = {"plain": _build_plain, "flba": _build_flba,
+             "bool": _build_bool, "dict": _build_dict,
+             "pstr": _build_pstr, "dstr": _build_dstr}
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _decode_file_jit(plan, arrays):
+    """plan: tuple of (builder key, statics, n_args) per column; arrays:
+    the flat device-arg tuple.  One dispatch decodes the whole file."""
+    outs = []
+    i = 0
+    for key, statics, k in plan:
+        outs.append(_BUILDERS[key](statics, arrays[i:i + k]))
+        i += k
+    return tuple(outs)
+
+
+def _scan_dict_str(parts, jvalid, n_total: int):
     """Dictionary-encoded strings fully on device (round 5).
 
     Host stages only metadata: the dict page's offsets recurrence (native
@@ -553,8 +631,9 @@ def _scan_dict_str(parts, jvalid, n_total: int) -> Optional[Column]:
             return None
         if total == 0:
             offs32 = jnp.zeros(n_total + 1, jnp.int32)
-            return Column(T.string, jnp.zeros(0, jnp.uint8), offs32,
+            col0 = Column(T.string, jnp.zeros(0, jnp.uint8), offs32,
                           jvalid)
+            return ("const", (), (), lambda _out: col0)
         combine = xpack.plan_combine(total, dspan, max_p, "dict_str_caps",
                                      final=(g == gs[-1]))
         if combine is not None:
@@ -563,12 +642,33 @@ def _scan_dict_str(parts, jvalid, n_total: int) -> Optional[Column]:
             break
     if geom is None:
         return None
-    chars, dst = _dict_str_chars(geom, dictmat, dict_lens, idx, jvalid)
-    return Column(T.string, chars, dst, jvalid)
+    statics = (geom, jvalid is not None)
+    args = (dictmat, dict_lens, idx) + ((jvalid,) if jvalid is not None
+                                        else ())
+
+    def assemble(out):
+        chars, dst = out
+        return Column(T.string, chars, dst, jvalid)
+    return ("dstr", statics, args, assemble)
 
 
 def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
-    """All row groups of one column via the device path; None → fall back."""
+    """All row groups of one column via the device path; None → fall back.
+    Eager form of :func:`stage_column_device` (single-column callers)."""
+    spec = stage_column_device(file_bytes, chunks, leaf)
+    if spec is None:
+        return None
+    key, statics, args, assemble = spec
+    if key == "const":
+        return assemble(None)
+    return assemble(_BUILDERS[key](statics, args))
+
+
+def stage_column_device(file_bytes: bytes, chunks, leaf):
+    """Host staging for one column → deferred decode spec
+    (key, statics, device-arg tuple, assemble) or None (host fallback).
+    The heavy decode body runs later — alone (scan_column_device) or
+    inlined into the per-file fused program (_decode_file_jit)."""
     parts = []
     for chunk in chunks:
         part = _walk_chunk_raw(file_bytes, chunk, leaf.max_def, leaf.max_rep,
@@ -599,6 +699,8 @@ def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
         # def levels expand ON DEVICE (bit test over the run plans)
         valid_np = None
         jvalid = _valid_device_concat(parts)
+    hv = jvalid is not None
+    vtail = (jvalid,) if hv else ()
 
     if kind == "dict_str":
         return _scan_dict_str(parts, jvalid, n_total)
@@ -622,6 +724,7 @@ def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
         ln = np.concatenate(lens) if lens else np.zeros(0, np.int32)
         dst = np.zeros(ln.shape[0] + 1, dtype=np.int64)
         np.cumsum(ln, out=dst[1:])
+        geom = None
         if ln.shape[0] == 0 or dst[-1] == 0:
             chars = jnp.zeros(0, jnp.uint8)
         else:
@@ -635,11 +738,8 @@ def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
             geom = xpack.plan_segmented_gather(st, ln, dst)
             if geom is None:
                 return None
-            chars = xpack.segmented_gather(
-                geom, jnp.asarray(np.frombuffer(payload, np.uint8)),
-                jnp.asarray(st.astype(np.int32)),
-                jnp.asarray(ln.astype(np.int32)),
-                jnp.asarray(dst.astype(np.int32)))
+            ln = ln.astype(np.int32)
+            chars = None           # deferred: the fused segmented gather
         if valid_np is None:
             row_lens = ln
         else:
@@ -649,7 +749,14 @@ def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
         np.cumsum(row_lens, out=offs_np[1:])
         joffs = jnp.asarray(offs_np.astype(np.int32))
         hostcache.seed(joffs, offs_np)
-        return Column(T.string, chars, joffs, jvalid)
+        if chars is not None:      # degenerate empty column: no jit body
+            col0 = Column(T.string, chars, joffs, jvalid)
+            return ("const", (), (), lambda _out: col0)
+        return ("pstr", (geom,),
+                (jnp.asarray(np.frombuffer(payload, np.uint8)),
+                 jnp.asarray(st.astype(np.int32)), jnp.asarray(ln),
+                 jnp.asarray(dst.astype(np.int32))),
+                lambda out: Column(T.string, out, joffs, jvalid))
 
     if kind == "plain_bool":
         def _npres(p):
@@ -667,16 +774,17 @@ def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
         payload = b"".join(p[3] for p in parts)
         k = int(sum(npresent))
         bits = jnp.asarray(np.frombuffer(payload, np.uint8))
-        data = _device_bool(k, bits, jvalid)
-        return Column(T.bool8, data, validity=jvalid)
+        return ("bool", (k, hv), (bits,) + vtail,
+                lambda out: Column(T.bool8, out, validity=jvalid))
 
     if kind == "plain":
         payload = b"".join(p[3] for p in parts)
         raw = jnp.asarray(np.frombuffer(payload, dtype=np.uint8))
         if is_flba:
-            data = _device_flba_decimal(leaf.type_len, raw, jvalid)
-        else:
-            data = _device_plain(phys, raw, jvalid)
+            return ("flba", (leaf.type_len, dt, hv), (raw,) + vtail,
+                    lambda out: Column(dt, out, validity=jvalid))
+        return ("plain", (phys, dt, hv), (raw,) + vtail,
+                lambda out: Column(dt, out, validity=jvalid))
     else:
         dicts = [p[2] for p in parts]
         base = dicts[0]
@@ -699,22 +807,21 @@ def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
             idx_all = [_idx_device_concat(p[3]) for p in parts]
             idx = jnp.concatenate(idx_all) if len(idx_all) > 1 \
                 else idx_all[0]
-        data = _device_dict(phys, dict_dev, idx, jvalid)
-    if is_flba:
-        # decimal narrowing mirrors the host path: lo limb for ≤18 digits
-        if dt.id == T.TypeId.DECIMAL128:
-            return Column(dt, data, validity=jvalid)
-        return Column(dt, data[:, 0].astype(dt.storage), validity=jvalid)
-    storage = dt.storage
-    if dt.id != T.TypeId.FLOAT64 and data.dtype != storage:
-        data = data.astype(storage)        # logical narrowing (date32 etc.)
-    return Column(dt, data, validity=jvalid)
+        return ("dict", (phys, dt, is_flba, hv),
+                (dict_dev, idx) + vtail,
+                lambda out: Column(dt, out, validity=jvalid))
 
 
 @traced("parquet_scan_table_device")
 def scan_table(file_bytes: bytes,
                columns: Optional[list[str]] = None) -> Table:
-    """``decode.read_table`` with the device fast path per column."""
+    """``decode.read_table`` with the device fast path per column.
+
+    All device-path columns decode in ONE fused jitted program per file
+    (``_decode_file_jit``; ``SRJT_FUSED_SCAN=0`` reverts to per-column
+    dispatches); host-fallback columns batch through ``decode.read_table``
+    as before."""
+    import os
     meta = parse_struct(extract_footer_bytes(file_bytes))
     leaves = D._leaf_schema_elements(meta)
     names = [leaf.name for leaf in leaves]
@@ -727,22 +834,35 @@ def scan_table(file_bytes: bytes,
         for i in want:
             chunk_lists[i].append(chunks[i])
 
-    cols = []
+    fused = os.environ.get("SRJT_FUSED_SCAN", "1").lower()         not in ("0", "off")
     fallback: list[int] = []
     by_index: dict[int, Column] = {}
+    deferred: list[tuple] = []          # (col index, key, statics, args,
+    #                                      assemble)
     for i in want:
-        col = scan_column_device(file_bytes, chunk_lists[i], leaves[i])
-        if col is None:
+        spec = stage_column_device(file_bytes, chunk_lists[i], leaves[i])
+        if spec is None:
             fallback.append(i)
+            continue
+        key, statics, args, assemble = spec
+        if key == "const":
+            by_index[i] = assemble(None)
+        elif fused:
+            deferred.append((i, key, statics, args, assemble))
         else:
-            by_index[i] = col
+            by_index[i] = assemble(_BUILDERS[key](statics, args))
+    if deferred:
+        plan = tuple((key, statics, len(args))
+                     for _, key, statics, args, _ in deferred)
+        flat = tuple(a for _, _, _, args, _ in deferred for a in args)
+        outs = _decode_file_jit(plan, flat)
+        for (i, _, _, _, assemble), out in zip(deferred, outs):
+            by_index[i] = assemble(out)
     if fallback:
         host = D.read_table(file_bytes, columns=[names[i] for i in fallback])
         for j, i in enumerate(fallback):
             by_index[i] = host[j]
-    for i in want:
-        cols.append(by_index[i])
-    return Table(cols)
+    return Table([by_index[i] for i in want])
 
 
 # API mirror: callers swap `from ..parquet import decode` for this module

@@ -375,3 +375,21 @@ def test_dict_strings_mostly_empty():
     dev = device_scan.scan_table(raw)
     host = decode.read_table(raw)
     _str_cols_equal(dev.columns[0], host.columns[0])
+
+
+def test_fused_scan_matches_per_column(monkeypatch):
+    """The per-file fused program must produce exactly what the
+    per-column dispatches produce."""
+    n = 4000
+    vals = [None if RNG.random() < 0.1 else f"w{i % 23}" for i in range(n)]
+    t = pa.table({
+        "s": pa.array(vals, pa.string()),
+        "v": pa.array(RNG.integers(0, 9, n), pa.int64()),
+        "f": pa.array(RNG.standard_normal(n), pa.float64()),
+        "b": pa.array(RNG.integers(0, 2, n) > 0),
+    })
+    raw = write(t, compression="SNAPPY", use_dictionary=True)
+    fused = device_scan.scan_table(raw)
+    monkeypatch.setenv("SRJT_FUSED_SCAN", "0")
+    percol = device_scan.scan_table(raw)
+    assert_tables_match(fused, percol)

@@ -304,9 +304,8 @@ def main():
     measure("fx_from_rows_words_full", decode_words, b0_c.data,
             row_bytes_c, "deinterleave+decode")
 
-    # 6. current public path at the bench schema
-    import bench as bench_mod
-    table = bench_mod.build_table(1_000_000, 12)
+    # 6. current public path at the bench schema (reuse section 5b's table)
+    table = tbl_c
     from spark_rapids_jni_tpu import convert_to_rows, convert_from_rows
     from spark_rapids_jni_tpu.column import Column, Table as _Table
 

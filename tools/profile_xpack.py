@@ -31,38 +31,18 @@ import jax.numpy as jnp
 RESULTS = {"stages": []}
 
 
-def _chained(body, data, lo=2, hi=8, reps=2):
-    @jax.jit
-    def run(d, iters):
-        def step(_, carry):
-            acc, dd = carry
-            din = jax.lax.optimization_barrier((dd, acc))[0]
-            out = body(din)
-            out = jax.lax.optimization_barrier(out)
-            leaves = [l for l in jax.tree_util.tree_leaves(out) if l.size]
-            probe = (jax.lax.convert_element_type(
-                jnp.ravel(leaves[0])[0], jnp.int32)
-                if leaves else jnp.int32(0))
-            return (acc + probe) % jnp.int32(65521), dd
-        acc, _ = jax.lax.fori_loop(0, iters, step, (jnp.int32(0), d))
-        return acc
+from benchmarks.measure import time_diff as _time_diff
 
-    np.asarray(run(data, lo))
-    best = None
-    for _ in range(reps + 2):
-        t0 = time.perf_counter()
-        np.asarray(run(data, lo))
-        t_lo = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        np.asarray(run(data, hi))
-        t_hi = time.perf_counter() - t0
-        per = (t_hi - t_lo) / (hi - lo)
-        if per > 0:
-            best = per if best is None else min(best, per)
-    return best
+
+def _chained(body, data, lo=2, hi=8, reps=2):
+    return _time_diff(body, data, lo, hi, reps)
 
 
 def record(name, per_s, nbytes, note=""):
+    if per_s is None:
+        RESULTS["stages"].append({"name": name, "error": "timing unusable"})
+        print(f"  {name}: timing unusable", flush=True)
+        return
     e = {"name": name, "per_iter_ms": round(per_s * 1e3, 2),
          "gbps": round(nbytes / per_s / 1e9, 3), "note": note}
     RESULTS["stages"].append(e)

@@ -147,17 +147,32 @@ def main():
         prev = RESULTS["queries"].get(name)
         if prev is not None:
             done = "steady_ms" in prev or "steady_skipped" in prev
-            gave_up = prev.get("crashes", 0) >= 2 or (
-                "error" in prev and not _crashed(prev["error"])
-                and not _transient(prev["error"]))
+            struck_out = (prev.get("crashes", 0) >= 2
+                          or prev.get("attempts", 0) >= 3)
+            gave_up = ("gave_up" in prev or struck_out
+                       or ("error" in prev and not _crashed(prev["error"])
+                           and not _transient(prev["error"])))
+            if struck_out and "gave_up" not in prev:
+                RESULTS["queries"][name] = {
+                    **prev, "gave_up": "attempt budget (hang/crash?)"}
             if done or gave_up:
                 continue
         fn = tpcds.QUERIES[name]
-        entry = {"crashes": (prev or {}).get("crashes", 0)}
+        # attempt accounting is written to disk BEFORE the query runs: a
+        # hung remote compile leaves no exception, so the only evidence a
+        # watchdog-killed attempt happened is this counter.  3 strikes →
+        # the query is abandoned on the next resume.
+        attempts = (prev or {}).get("attempts", 0) + 1
+        RESULTS["queries"][name] = {**(prev or {}), "attempts": attempts}
+        with open(out_path, "w") as f:
+            json.dump(RESULTS, f, indent=1)
+        entry = {"crashes": (prev or {}).get("crashes", 0),
+                 "attempts": attempts}
         # transient remote-compile failures (HTTP 5xx) retry in-process;
         # an entry whose only error is transient is also retried on resume
         if prev and "error" in prev and _transient(prev["error"]):
             entry = {k: v for k, v in prev.items() if k != "error"}
+            entry["attempts"] = attempts   # keep the pre-run increment
         try:
             # cold: eager capture (compiles + size syncs, tape recorded)
             syncs.reset_sync_count()
