@@ -435,6 +435,51 @@ def q_null_share(dfs):
                  s=("ws_ext_sales_price", "sum")))
 
 
+def q17_stats(dfs):
+    ss, store = dfs["store_sales"], dfs["store"]
+    j = ss.merge(store, left_on="ss_store_sk", right_on="s_store_sk")
+    return (j.groupby("s_state", as_index=False)
+            .agg(m=("ss_quantity", "mean"), sd=("ss_quantity", "std"),
+                 c=("ss_quantity", "count")))
+
+
+def q8_intersect(dfs):
+    ss, ws, item = dfs["store_sales"], dfs["web_sales"], dfs["item"]
+    js = ss.merge(item, left_on="ss_item_sk", right_on="i_item_sk")
+    jw = ws.merge(item, left_on="ws_item_sk", right_on="i_item_sk")
+    both = np.intersect1d(js.i_category_id.unique(),
+                          jw.i_category_id.unique())
+    return pd.DataFrame({"i_category_id": np.sort(both)})
+
+
+def q87_except(dfs):
+    ss, ws, item = dfs["store_sales"], dfs["web_sales"], dfs["item"]
+    js = ss.merge(item, left_on="ss_item_sk", right_on="i_item_sk")
+    jw = ws.merge(item, left_on="ws_item_sk", right_on="i_item_sk")
+    only = np.setdiff1d(js.i_brand_id.unique(), jw.i_brand_id.unique())
+    return pd.DataFrame({"i_brand_id": np.sort(only)})
+
+
+def q_dense_rank_cat(dfs, top_n=2):
+    ss, item, dd = dfs["store_sales"], dfs["item"], dfs["date_dim"]
+    j = (ss.merge(item, left_on="ss_item_sk", right_on="i_item_sk")
+         .merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk"))
+    rev = (j.groupby(["i_category", "d_moy"], as_index=False)
+           ["ss_ext_sales_price"].sum())
+    rev["dr"] = (rev.groupby("i_category")["ss_ext_sales_price"]
+                 .rank(method="dense", ascending=False).astype(int))
+    return rev[rev.dr <= top_n]
+
+
+def q34_baskets(dfs, qty_min=60):
+    ss = dfs["store_sales"]
+    per_item = (ss.groupby(["ss_store_sk", "ss_item_sk"], as_index=False)
+                ["ss_quantity"].sum())
+    big = per_item[per_item.ss_quantity >= qty_min]
+    return (big.groupby("ss_store_sk", as_index=False)
+            ["ss_item_sk"].count())
+
+
 QUERIES = {
     "q3": q3, "q42": q42, "q52": q52, "q55": q55,
     "q_state_rollup": q_state_rollup, "q7": q7, "q19": q19, "q62": q62,
@@ -455,4 +500,7 @@ QUERIES = {
     "q_rollup3": q_rollup3, "q_first_last": q_first_last,
     "q_rownum_dedup": q_rownum_dedup, "q_cross_ratio": q_cross_ratio,
     "q_null_share": q_null_share,
+    "q17_stats": q17_stats, "q8_intersect": q8_intersect,
+    "q87_except": q87_except, "q_dense_rank_cat": q_dense_rank_cat,
+    "q34_baskets": q34_baskets,
 }

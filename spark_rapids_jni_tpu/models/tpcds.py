@@ -792,6 +792,91 @@ def q_null_share(tables: dict[str, Table]) -> Table:
     return sort_table(out, [0])
 
 
+# ---------------------------------------------------------------------------
+# round-5 breadth: stddev aggregate, INTERSECT/EXCEPT, dense_rank,
+# two-level (aggregate-of-aggregate) groupby
+# ---------------------------------------------------------------------------
+
+def q17_stats(tables: dict[str, Table]) -> Table:
+    """Quantity dispersion per state (Q17 shape: mean + stddev + count of
+    the same measure in one pass)."""
+    ss, store = tables["store_sales"], tables["store"]
+    j = inner_join(ss, store, _col(SS_COLS, "ss_store_sk"),
+                   _col(STORE_COLS, "s_store_sk"))
+    cols = SS_COLS + STORE_COLS
+    qi = cols.index("ss_quantity")
+    out = groupby_aggregate(j, [cols.index("s_state")],
+                            [(qi, "mean"), (qi, "std"), (qi, "count")])
+    return sort_table(out, [0])
+
+
+def q8_intersect(tables: dict[str, Table]) -> Table:
+    """Categories sold in BOTH channels (INTERSECT shape, Q8/Q38 spirit):
+    distinct store categories ∩ distinct web categories via semi join."""
+    ss, ws, item = (tables["store_sales"], tables["web_sales"],
+                    tables["item"])
+    js = inner_join(ss, item, _col(SS_COLS, "ss_item_sk"),
+                    _col(ITEM_COLS, "i_item_sk"))
+    jw = inner_join(ws, item, _col(WS_COLS, "ws_item_sk"),
+                    _col(ITEM_COLS, "i_item_sk"))
+    cs = SS_COLS + ITEM_COLS
+    cw = WS_COLS + ITEM_COLS
+    s_cat = distinct(Table([js[cs.index("i_category_id")]]))
+    w_cat = distinct(Table([jw[cw.index("i_category_id")]]))
+    both = semi_join(s_cat, w_cat, 0, 0)
+    return sort_table(both, [0])
+
+
+def q87_except(tables: dict[str, Table]) -> Table:
+    """Brands sold in store but NEVER on the web (EXCEPT shape, Q87):
+    distinct store brands ∖ distinct web brands via anti join."""
+    ss, ws, item = (tables["store_sales"], tables["web_sales"],
+                    tables["item"])
+    js = inner_join(ss, item, _col(SS_COLS, "ss_item_sk"),
+                    _col(ITEM_COLS, "i_item_sk"))
+    jw = inner_join(ws, item, _col(WS_COLS, "ws_item_sk"),
+                    _col(ITEM_COLS, "i_item_sk"))
+    cs = SS_COLS + ITEM_COLS
+    cw = WS_COLS + ITEM_COLS
+    s_b = distinct(Table([js[cs.index("i_brand_id")]]))
+    w_b = distinct(Table([jw[cw.index("i_brand_id")]]))
+    only = anti_join(s_b, w_b, 0, 0)
+    return sort_table(only, [0])
+
+
+def q_dense_rank_cat(tables: dict[str, Table], top_n: int = 2) -> Table:
+    """DENSE_RANK window (Q70 shape): top-N revenue months per category,
+    ties share a rank without gaps."""
+    ss, item, dd = tables["store_sales"], tables["item"], tables["date_dim"]
+    j1 = inner_join(ss, item, _col(SS_COLS, "ss_item_sk"),
+                    _col(ITEM_COLS, "i_item_sk"))
+    cols1 = SS_COLS + ITEM_COLS
+    j2 = inner_join(j1, dd, cols1.index("ss_sold_date_sk"),
+                    _col(DATE_COLS, "d_date_sk"))
+    cols = cols1 + DATE_COLS
+    rev = groupby_aggregate(
+        j2, [cols.index("i_category"), cols.index("d_moy")],
+        [(cols.index("ss_ext_sales_price"), "sum")])
+    spec = W.WindowSpec(rev, partition_by=[0], order_by_keys=[2, 1],
+                        ascending=[False, True])
+    dr = W.dense_rank(spec, [2])
+    out = apply_boolean_mask(Table(list(rev.columns) + [dr]),
+                             dr.values() <= top_n)
+    return sort_table(out, [0, 3, 1])
+
+
+def q34_baskets(tables: dict[str, Table], qty_min: int = 60) -> Table:
+    """Two-level aggregation (Q34 shape): per store, how many ITEMS have
+    a high-quantity sale total — a groupby over a groupby's output."""
+    ss = tables["store_sales"]
+    per_item = groupby_aggregate(
+        ss, [_col(SS_COLS, "ss_store_sk"), _col(SS_COLS, "ss_item_sk")],
+        [(_col(SS_COLS, "ss_quantity"), "sum")])
+    big = apply_boolean_mask(per_item, per_item[2].data >= qty_min)
+    out = groupby_aggregate(big, [0], [(1, "count")])
+    return sort_table(out, [0])
+
+
 QUERIES = {"q3": q3, "q42": q42, "q52": q52, "q55": q55,
            "q_state_rollup": q_state_rollup, "q7": q7, "q19": q19,
            "q62": q62, "q52_topn": q52_topn, "q65": q65,
@@ -813,11 +898,16 @@ QUERIES = {"q3": q3, "q42": q42, "q52": q52, "q55": q55,
            "q_minmax_price": q_minmax_price,
            "q_multi_measure": q_multi_measure, "q_rollup3": q_rollup3,
            "q_first_last": q_first_last, "q_rownum_dedup": q_rownum_dedup,
-           "q_cross_ratio": q_cross_ratio, "q_null_share": q_null_share}
+           "q_cross_ratio": q_cross_ratio, "q_null_share": q_null_share,
+           # round-5 breadth
+           "q17_stats": q17_stats, "q8_intersect": q8_intersect,
+           "q87_except": q87_except, "q_dense_rank_cat": q_dense_rank_cat,
+           "q34_baskets": q34_baskets}
 
 # queries that read the second fact table (skipped when absent)
 _NEEDS_WEB = {"q_union_channels", "q5_grouping_sets", "q78_outer",
-              "q25_two_fact", "q_cross_ratio", "q_null_share"}
+              "q25_two_fact", "q_cross_ratio", "q_null_share",
+              "q8_intersect", "q87_except"}
 
 
 def run_all(files: dict[str, bytes]) -> dict[str, Table]:

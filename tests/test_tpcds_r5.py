@@ -1,0 +1,104 @@
+"""Round-5 query breadth — stddev aggregate, INTERSECT/EXCEPT, DENSE_RANK,
+two-level groupby — each compared against pandas running the same plan
+over the same parquet bytes (the suite's differential pattern)."""
+
+import io
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from benchmarks import tpcds_data
+from spark_rapids_jni_tpu.models import tpcds
+
+
+@pytest.fixture(scope="module")
+def files():
+    return tpcds_data.generate(n_sales=30_000, n_items=400, seed=23)
+
+
+@pytest.fixture(scope="module")
+def dfs(files):
+    return {name: pd.read_parquet(io.BytesIO(raw))
+            for name, raw in files.items()}
+
+
+@pytest.fixture(scope="module")
+def tables(files):
+    return tpcds.load_tables(files)
+
+
+def test_q17_stats(tables, dfs):
+    out = tpcds.q17_stats(tables)
+    ss, store = dfs["store_sales"], dfs["store"]
+    j = ss.merge(store, left_on="ss_store_sk", right_on="s_store_sk")
+    exp = (j.groupby("s_state", as_index=False)
+           .agg(m=("ss_quantity", "mean"), sd=("ss_quantity", "std"),
+                c=("ss_quantity", "count"))
+           .sort_values("s_state").reset_index(drop=True))
+    assert out[0].to_pylist() == exp.s_state.tolist()
+    np.testing.assert_allclose(np.asarray(out[1].to_numpy(), np.float64),
+                               exp.m.to_numpy(), rtol=1e-9)
+    # pandas std is the sample std (ddof=1) — the Spark STDDEV default
+    np.testing.assert_allclose(np.asarray(out[2].to_numpy(), np.float64),
+                               exp.sd.to_numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(out[3].to_numpy()),
+                                  exp.c.to_numpy())
+
+
+def test_q8_intersect(tables, dfs):
+    out = tpcds.q8_intersect(tables)
+    ss, ws, item = dfs["store_sales"], dfs["web_sales"], dfs["item"]
+    js = ss.merge(item, left_on="ss_item_sk", right_on="i_item_sk")
+    jw = ws.merge(item, left_on="ws_item_sk", right_on="i_item_sk")
+    exp = np.sort(np.intersect1d(js.i_category_id.unique(),
+                                 jw.i_category_id.unique()))
+    np.testing.assert_array_equal(np.asarray(out[0].to_numpy()), exp)
+
+
+def test_q87_except(tables, dfs):
+    out = tpcds.q87_except(tables)
+    ss, ws, item = dfs["store_sales"], dfs["web_sales"], dfs["item"]
+    js = ss.merge(item, left_on="ss_item_sk", right_on="i_item_sk")
+    jw = ws.merge(item, left_on="ws_item_sk", right_on="i_item_sk")
+    exp = np.sort(np.setdiff1d(js.i_brand_id.unique(),
+                               jw.i_brand_id.unique()))
+    np.testing.assert_array_equal(np.asarray(out[0].to_numpy()), exp)
+
+
+def test_q_dense_rank_cat(tables, dfs):
+    out = tpcds.q_dense_rank_cat(tables)
+    ss, item, dd = dfs["store_sales"], dfs["item"], dfs["date_dim"]
+    j = (ss.merge(item, left_on="ss_item_sk", right_on="i_item_sk")
+         .merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk"))
+    rev = (j.groupby(["i_category", "d_moy"], as_index=False)
+           ["ss_ext_sales_price"].sum())
+    rev["dr"] = (rev.groupby("i_category")["ss_ext_sales_price"]
+                 .rank(method="dense", ascending=False).astype(int))
+    exp = (rev[rev.dr <= 2]
+           .sort_values(["i_category", "dr", "d_moy"])
+           .reset_index(drop=True))
+    assert out.num_rows == len(exp)
+    assert out[0].to_pylist() == exp.i_category.tolist()
+    np.testing.assert_array_equal(np.asarray(out[1].to_numpy()),
+                                  exp.d_moy.to_numpy())
+    np.testing.assert_allclose(np.asarray(out[2].to_numpy(), np.float64),
+                               exp.ss_ext_sales_price.to_numpy(),
+                               rtol=1e-9)
+    np.testing.assert_array_equal(np.asarray(out[3].to_numpy()),
+                                  exp.dr.to_numpy())
+
+
+def test_q34_baskets(tables, dfs):
+    out = tpcds.q34_baskets(tables)
+    ss = dfs["store_sales"]
+    per_item = (ss.groupby(["ss_store_sk", "ss_item_sk"], as_index=False)
+                ["ss_quantity"].sum())
+    big = per_item[per_item.ss_quantity >= 60]
+    exp = (big.groupby("ss_store_sk", as_index=False)["ss_item_sk"]
+           .count().sort_values("ss_store_sk").reset_index(drop=True))
+    assert out.num_rows == len(exp)
+    np.testing.assert_array_equal(np.asarray(out[0].to_numpy()),
+                                  exp.ss_store_sk.to_numpy())
+    np.testing.assert_array_equal(np.asarray(out[1].to_numpy()),
+                                  exp.ss_item_sk.to_numpy())
