@@ -146,7 +146,12 @@ def main():
     for name in chosen:
         prev = RESULTS["queries"].get(name)
         if prev is not None:
-            done = "steady_ms" in prev or "steady_skipped" in prev
+            steady_on = os.environ.get("SRJT_QB_STEADY", "1") \
+                not in ("0", "off")
+            done = ("steady_ms" in prev
+                    or ("steady_skipped" in prev
+                        and not (steady_on
+                                 and "disabled" in prev["steady_skipped"])))
             struck_out = (prev.get("crashes", 0) >= 2
                           or prev.get("attempts", 0) >= 3)
             gave_up = ("gave_up" in prev or struck_out
@@ -213,7 +218,9 @@ def main():
             # Heavy queries skip it: the differencing loop multiplies the
             # on-chip work and a long-running loop is what crashed the
             # worker in the first full-sweep attempt (q19, 34 s warm).
-            if entry["warm_unchecked_s"] > 10:
+            if os.environ.get("SRJT_QB_STEADY", "1") in ("0", "off"):
+                entry["steady_skipped"] = "disabled (SRJT_QB_STEADY=0)"
+            elif entry["warm_unchecked_s"] > 10:
                 entry["steady_skipped"] = "warm > 10s"
             else:
                 per = steady_per_iter(cq._prog, tables)
