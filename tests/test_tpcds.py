@@ -202,7 +202,10 @@ def test_run_all_smoke(files):
     results = tpcds.run_all(files)
     assert set(results) == set(tpcds.QUERIES)
     for name, t in results.items():
-        assert t.num_columns >= 2, name
+        # set-operation queries (INTERSECT/EXCEPT) legitimately return a
+        # single key column; everything else carries keys + measures
+        min_cols = 1 if name in ("q8_intersect", "q87_except") else 2
+        assert t.num_columns >= min_cols, name
         assert t.num_rows >= 0, name
 
 

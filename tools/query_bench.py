@@ -143,6 +143,11 @@ def main():
               flush=True)
         os.execv(sys.executable, [sys.executable] + sys.argv)
 
+    # fewest-attempts-first: fresh queries run before retry-prone ones, so
+    # one process lifetime completes every healthy query even when a
+    # hang-prone query would otherwise eat the watchdog budget first
+    chosen = sorted(chosen, key=lambda q: (
+        RESULTS["queries"].get(q, {}).get("attempts", 0), q))
     for name in chosen:
         prev = RESULTS["queries"].get(name)
         if prev is not None:
@@ -153,7 +158,7 @@ def main():
                         and not (steady_on
                                  and "disabled" in prev["steady_skipped"])))
             struck_out = (prev.get("crashes", 0) >= 2
-                          or prev.get("attempts", 0) >= 3)
+                          or prev.get("attempts", 0) >= 2)
             gave_up = ("gave_up" in prev or struck_out
                        or ("error" in prev and not _crashed(prev["error"])
                            and not _transient(prev["error"])))

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Summarize QUERY_BENCH vs HOST_QUERY_BASELINE → the SF1 subset totals
+the north-star metric tracks.  Prints one JSON object and updates
+QUERY_BENCH.json's "summary" key in place."""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+
+def main():
+    qb_path = sys.argv[1] if len(sys.argv) > 1 else "QUERY_BENCH.json"
+    hb_path = sys.argv[2] if len(sys.argv) > 2 else "HOST_QUERY_BASELINE.json"
+    qb = json.load(open(qb_path))
+    hb = json.load(open(hb_path))
+    chip = qb["queries"]
+    host = hb["queries"]
+    names = sorted(set(chip) & set(host))
+    rows = []
+    for n in names:
+        c, h = chip[n], host[n]
+        if "warm_unchecked_s" not in c or "wall_s" not in h:
+            continue
+        rows.append({
+            "query": n,
+            "chip_warm_s": c["warm_wall_s"],
+            "chip_unchecked_s": c["warm_unchecked_s"],
+            "chip_steady_ms": c.get("steady_ms"),
+            "pandas_s": h["wall_s"],
+            "chip_wins_warm": c["warm_wall_s"] <= h["wall_s"],
+            "chip_wins_unchecked": c["warm_unchecked_s"] <= h["wall_s"],
+        })
+    summary = {
+        "queries_compared": len(rows),
+        "chip_warm_total_s": round(sum(r["chip_warm_s"] for r in rows), 2),
+        "chip_unchecked_total_s": round(
+            sum(r["chip_unchecked_s"] for r in rows), 2),
+        "pandas_total_s": round(sum(r["pandas_s"] for r in rows), 2),
+        "wins_warm": sum(r["chip_wins_warm"] for r in rows),
+        "wins_unchecked": sum(r["chip_wins_unchecked"] for r in rows),
+        "measured_chip": sum(1 for e in chip.values()
+                             if "warm_unchecked_s" in e),
+        "with_steady": sum(1 for e in chip.values()
+                           if e.get("steady_ms") is not None),
+    }
+    qb["summary"] = summary
+    with open(qb_path, "w") as f:
+        json.dump(qb, f, indent=1)
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()
