@@ -247,6 +247,22 @@ def _stage_column_dt(data: jnp.ndarray, dt) -> jnp.ndarray:
     return _stage_column(data, dt.storage)
 
 
+def _pack_validity_words(layout: RowLayout,
+                         valid: jnp.ndarray) -> list[jnp.ndarray]:
+    """Per validity byte k: u32 [n] vector with the byte's bits in the low
+    8 — shared by both fixed compose engines (the byte-identical invariant
+    the differential test pins depends on ONE packing implementation)."""
+    n = valid.shape[0]
+    out = []
+    for k in range(layout.validity_bytes):
+        acc = jnp.zeros((n,), jnp.uint32)
+        for i in range(min(8, layout.num_columns - k * 8)):
+            acc = acc | (valid[:, k * 8 + i].astype(jnp.uint32)
+                         << jnp.uint32(i))
+        out.append(acc)
+    return out
+
+
 @functools.partial(jax.jit, static_argnums=0)
 def _to_rows_fixed_words(layout: RowLayout, datas: tuple[jnp.ndarray, ...],
                          valid: jnp.ndarray) -> jnp.ndarray:
@@ -266,13 +282,7 @@ def _to_rows_fixed_words(layout: RowLayout, datas: tuple[jnp.ndarray, ...],
 
     staged = [padrows(_stage_column_dt(d, dt))
               for d, dt in zip(datas, layout.schema)]
-    vbytes_w = []
-    for k in range(layout.validity_bytes):
-        acc = jnp.zeros((n,), jnp.uint32)
-        for i in range(min(8, layout.num_columns - k * 8)):
-            acc = acc | (valid[:, k * 8 + i].astype(jnp.uint32)
-                         << jnp.uint32(i))
-        vbytes_w.append(padrows(acc))
+    vbytes_w = [padrows(v) for v in _pack_validity_words(layout, valid)]
 
     plan = _word_plan(layout)
     words = []
@@ -353,6 +363,136 @@ def _decode_row_words(layout: RowLayout, word, n: int):
     return tuple(datas), valid, tuple(slots)
 
 
+# Concat-based fixed compose (round-5 alternate engine, SRJT_FIXED_CONCAT):
+# instead of composing W per-word [n] vectors and permuting them into row
+# order, build the [n, W] row-word matrix DIRECTLY as one axis-1
+# concatenate of per-column u32 blocks — an 8-byte column's natural
+# [n, 2] bitcast IS its two adjacent row words, so the formulation has no
+# per-word lane selects and no 3-D permute; alignment gaps become zero
+# blocks and co-worded sub-byte columns pre-combine.  The inverse slices
+# the same blocks back out.  Chip A/B decides the default; both paths are
+# byte-identical (differential-tested).
+
+def _word_blocks(layout: RowLayout):
+    """Static [start_word, word_count, members] runs covering the row:
+    members = [(col_index | 'valid', kind, arg)] sharing the run."""
+    W = layout.fixed_row_size // 4
+    owner: list[list] = [[] for _ in range(W)]
+    for ci, dt in enumerate(layout.schema):
+        start = layout.column_starts[ci]
+        size = layout.column_sizes[ci]
+        w0 = start // 4
+        if size >= 4:
+            for j in range(size // 4):
+                owner[w0 + j].append((ci, "wide", j))
+        else:
+            owner[w0].append((ci, "sub", start % 4))
+    vo = layout.validity_offset
+    for k in range(layout.validity_bytes):
+        byte = vo + k
+        owner[byte // 4].append(("valid", "vbyte", (k, byte % 4)))
+    return owner
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _to_rows_fixed_concat(layout: RowLayout, datas: tuple[jnp.ndarray, ...],
+                          valid: jnp.ndarray) -> jnp.ndarray:
+    """Fixed-width columns + validity matrix → flat u32 row words [n*W]
+    via ONE axis-1 concatenate of per-column blocks."""
+    n = valid.shape[0]
+    W = layout.fixed_row_size // 4
+    owner = _word_blocks(layout)
+    staged = {}
+
+    def stage(ci):
+        if ci not in staged:
+            staged[ci] = _stage_column_dt(datas[ci], layout.schema[ci])
+        return staged[ci]
+
+    vbytes_w = _pack_validity_words(layout, valid)
+
+    blocks = []
+    w = 0
+    while w < W:
+        mem = owner[w]
+        if not mem:
+            # alignment gap: extend over the whole zero run
+            w1 = w
+            while w1 < W and not owner[w1]:
+                w1 += 1
+            blocks.append(jnp.zeros((n, w1 - w), jnp.uint32))
+            w = w1
+            continue
+        if len(mem) == 1 and mem[0][1] == "wide" and mem[0][2] == 0:
+            ci = mem[0][0]
+            x = stage(ci)
+            blocks.append(x[:, None] if x.ndim == 1 else x)
+            w += 1 if x.ndim == 1 else x.shape[1]
+            continue
+        # mixed word: sub-word columns and/or validity bytes combine
+        acc = jnp.zeros((n,), jnp.uint32)
+        for ci, kind, arg in mem:
+            if kind == "vbyte":
+                k, shift = arg
+                acc = acc | (vbytes_w[k] << jnp.uint32(shift * 8))
+            elif kind == "sub":
+                acc = acc | (stage(ci) << jnp.uint32(arg * 8))
+            else:                      # a wide column's j-th word
+                x = stage(ci)
+                acc = acc | (x if x.ndim == 1 else x[:, arg])
+        blocks.append(acc[:, None])
+        w += 1
+    return jnp.concatenate(blocks, axis=1).reshape(-1)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _from_rows_fixed_concat(layout: RowLayout, flat: jnp.ndarray):
+    """Inverse: [n, W] row-word matrix sliced back into column blocks."""
+    W = layout.fixed_row_size // 4
+    n = flat.shape[0] // W
+    m2 = flat.reshape(n, W)
+    datas = []
+    for ci, dt in enumerate(layout.schema):
+        start = layout.column_starts[ci]
+        size = layout.column_sizes[ci]
+        w0 = start // 4
+        if size == 16:
+            quad = m2[:, w0:w0 + 4]
+            datas.append(jax.lax.bitcast_convert_type(
+                quad.reshape(-1, 2, 2), jnp.int64))
+            continue
+        st = dt.storage
+        if size == 8:
+            pair = m2[:, w0:w0 + 2]
+            datas.append(pair if _is_f64(st)
+                         else jax.lax.bitcast_convert_type(pair,
+                                                           jnp.dtype(st)))
+        elif size == 4:
+            datas.append(jax.lax.bitcast_convert_type(m2[:, w0],
+                                                      jnp.dtype(st)))
+        else:
+            v = ((m2[:, w0] >> jnp.uint32(8 * (start % 4)))
+                 & jnp.uint32((1 << (8 * size)) - 1))
+            unsigned = np.dtype(f"u{size}")
+            datas.append(jax.lax.bitcast_convert_type(
+                v.astype(jnp.dtype(unsigned)), jnp.dtype(st)))
+    vcols = []
+    for c in range(layout.num_columns):
+        byte = layout.validity_offset + c // 8
+        bit = ((m2[:, byte // 4] >> jnp.uint32(8 * (byte % 4) + c % 8))
+               & jnp.uint32(1))
+        vcols.append(bit.astype(jnp.bool_))
+    return tuple(datas), jnp.stack(vcols, axis=1)
+
+
+def _fixed_engine() -> str:
+    """Read OUTSIDE jit and pass as a static arg — an env read inside a
+    jitted body would be baked into the first trace and ignore later
+    changes (the jit cache keys on layout/shapes only)."""
+    return ("concat" if os.environ.get("SRJT_FIXED_CONCAT", "0").lower()
+            in ("1", "on") else "perm")
+
+
 @functools.partial(jax.jit, static_argnums=0)
 def _from_rows_fixed_words(layout: RowLayout, flat: jnp.ndarray):
     """Flat u32 row words [n*W] → (datas tuple, valid bool [n, ncols])."""
@@ -373,8 +513,9 @@ def _from_rows_fixed_words(layout: RowLayout, flat: jnp.ndarray):
 # validity-matrix build, word compose, interleave, offsets arange — is one
 # jit program and the only transfer is the column payloads already in HBM.
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
 def _to_rows_fixed_full(layout: RowLayout, has_valid: tuple[bool, ...],
+                        engine: str,
                         datas: tuple[jnp.ndarray, ...],
                         valids: tuple[jnp.ndarray, ...]):
     """Fixed-width table → (flat u32 row words, int32 row offsets), one
@@ -386,15 +527,20 @@ def _to_rows_fixed_full(layout: RowLayout, has_valid: tuple[bool, ...],
     cols_valid = [next(vi) if hv else jnp.ones((n,), dtype=jnp.bool_)
                   for hv in has_valid]
     valid = jnp.stack(cols_valid, axis=1)
-    flat = _to_rows_fixed_words(layout, datas, valid)
+    flat = (_to_rows_fixed_concat(layout, datas, valid)
+            if engine == "concat"
+            else _to_rows_fixed_words(layout, datas, valid))
     offsets = jnp.arange(n + 1, dtype=jnp.int32) * layout.fixed_row_size
     return flat, offsets
 
 
-@functools.partial(jax.jit, static_argnums=0)
-def _from_rows_fixed_full(layout: RowLayout, words: jnp.ndarray):
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _from_rows_fixed_full(layout: RowLayout, engine: str,
+                          words: jnp.ndarray):
     """Flat u32 row words → (datas, per-column validity vectors)."""
-    datas, valid = _from_rows_fixed_words(layout, words)
+    datas, valid = (_from_rows_fixed_concat(layout, words)
+                    if engine == "concat"
+                    else _from_rows_fixed_words(layout, words))
     valids = tuple(valid[:, ci] for ci in range(layout.num_columns))
     return datas, valids
 
@@ -772,7 +918,7 @@ def convert_to_rows(table: Table,
             cols = (table.columns if (lo, hi) == (0, n)
                     else [_slice_column(c, lo, hi) for c in table.columns])
             data, offsets = _to_rows_fixed_full(
-                layout, has_valid,
+                layout, has_valid, _fixed_engine(),
                 tuple(c.data for c in cols),
                 tuple(c.validity for c in cols if c.validity is not None))
             out.append(RowBatch(data, offsets))
@@ -861,7 +1007,7 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
                 f"describe {n} rows of {layout.fixed_row_size} bytes")
         words = (batch.data if batch.data.dtype == jnp.uint32
                  else _bytes_to_words(batch.data))
-        datas, valids = _from_rows_fixed_full(layout, words)
+        datas, valids = _from_rows_fixed_full(layout, _fixed_engine(), words)
         cols = [Column(dt, datas[ci], validity=valids[ci])
                 for ci, dt in enumerate(schema)]
         return Table(cols)
