@@ -485,12 +485,18 @@ def _from_rows_fixed_concat(layout: RowLayout, flat: jnp.ndarray):
     return tuple(datas), jnp.stack(vcols, axis=1)
 
 
-def _fixed_engine() -> str:
-    """Read OUTSIDE jit and pass as a static arg — an env read inside a
-    jitted body would be baked into the first trace and ignore later
-    changes (the jit cache keys on layout/shapes only)."""
-    return ("concat" if os.environ.get("SRJT_FIXED_CONCAT", "0").lower()
-            in ("1", "on") else "perm")
+def _fixed_engine(direction: str) -> str:
+    """Measured round-5 policy (chip A/B, BASELINE.md): compose-to-rows
+    keeps the perm3 word engine (39.8/57.2 GB/s vs concat's 28.2 and a
+    64x-padding OOM at 212 cols — axis-1 concatenate of narrow blocks
+    writes terribly), while decode-from-rows uses the concat engine
+    everywhere (contiguous [n, W] slices: 64.1 GB/s at 12 cols, 825 GB/s
+    at 212 vs perm's 26.5/192.7).  SRJT_FIXED_CONCAT overrides both
+    directions for A/B; read OUTSIDE jit and passed as a static arg."""
+    env = os.environ.get("SRJT_FIXED_CONCAT")
+    if env is not None:
+        return "concat" if env.lower() in ("1", "on") else "perm"
+    return "perm" if direction == "to" else "concat"
 
 
 @functools.partial(jax.jit, static_argnums=0)
@@ -918,7 +924,7 @@ def convert_to_rows(table: Table,
             cols = (table.columns if (lo, hi) == (0, n)
                     else [_slice_column(c, lo, hi) for c in table.columns])
             data, offsets = _to_rows_fixed_full(
-                layout, has_valid, _fixed_engine(),
+                layout, has_valid, _fixed_engine("to"),
                 tuple(c.data for c in cols),
                 tuple(c.validity for c in cols if c.validity is not None))
             out.append(RowBatch(data, offsets))
@@ -1007,7 +1013,8 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
                 f"describe {n} rows of {layout.fixed_row_size} bytes")
         words = (batch.data if batch.data.dtype == jnp.uint32
                  else _bytes_to_words(batch.data))
-        datas, valids = _from_rows_fixed_full(layout, _fixed_engine(), words)
+        datas, valids = _from_rows_fixed_full(layout, _fixed_engine("from"),
+                                              words)
         cols = [Column(dt, datas[ci], validity=valids[ci])
                 for ci, dt in enumerate(schema)]
         return Table(cols)
