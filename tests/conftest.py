@@ -21,3 +21,19 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import gc
+
+import pytest
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _clear_jax_caches_per_module():
+    """Cap compiled-executable memory across the (large) suite: two full
+    runs segfaulted inside XLA:CPU's backend_compile around the ~85% mark
+    with hundreds of live executables; dropping caches between modules
+    trades some recompiles for a bounded footprint."""
+    yield
+    jax.clear_caches()
+    gc.collect()
