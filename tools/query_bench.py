@@ -158,7 +158,7 @@ def main():
                         and not (steady_on
                                  and "disabled" in prev["steady_skipped"])))
             struck_out = (prev.get("crashes", 0) >= 2
-                          or prev.get("attempts", 0) >= 2)
+                          or prev.get("attempts", 0) >= 4)
             gave_up = ("gave_up" in prev or struck_out
                        or ("error" in prev and not _crashed(prev["error"])
                            and not _transient(prev["error"])))
