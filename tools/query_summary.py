@@ -31,8 +31,16 @@ def main():
             "chip_wins_warm": c["warm_wall_s"] <= h["wall_s"],
             "chip_wins_unchecked": c["warm_unchecked_s"] <= h["wall_s"],
         })
+    steady_rows = [r for r in rows if r["chip_steady_ms"] is not None]
     summary = {
         "queries_compared": len(rows),
+        "steady_measured": len(steady_rows),
+        "wins_steady": sum(r["chip_steady_ms"] / 1e3 <= r["pandas_s"]
+                           for r in steady_rows),
+        "steady_total_ms": round(sum(r["chip_steady_ms"]
+                                     for r in steady_rows), 1),
+        "pandas_total_for_steady_set_s": round(
+            sum(r["pandas_s"] for r in steady_rows), 3),
         "chip_warm_total_s": round(sum(r["chip_warm_s"] for r in rows), 2),
         "chip_unchecked_total_s": round(
             sum(r["chip_unchecked_s"] for r in rows), 2),
