@@ -26,6 +26,14 @@ The compiled program is exact for any table data with the same resolved
 sizes; re-running against data whose sizes differ requires re-capture
 (callers hold a :class:`CompiledQuery` per dataset — the analytics
 steady-state, where plans are re-executed over refreshed same-shape data).
+
+Join engine v2 (``ops/join_plan.py``) routes its planner decisions —
+build-key min/max/uniqueness, which pick dense-lookup vs sort-probe —
+through the same ``syncs.scalar`` funnel, so they are recorded on the tape
+and re-checked by the staleness guard: a replay against data whose key
+range flips the dense/sorted choice raises :class:`StaleTapeError` instead
+of silently probing with the wrong engine.  (The identity-keyed build-index
+memo is disabled under capture/replay so tapes stay aligned.)
 """
 
 from __future__ import annotations

@@ -24,8 +24,8 @@ from ..column import Column, Table
 from ..ops import (anti_join, apply_boolean_mask, concat_tables, distinct,
                    fill_null, full_outer_join, groupby_aggregate,
                    groupby_cube, groupby_grouping_sets, groupby_nunique,
-                   groupby_rollup, inner_join, isin, left_join, mean,
-                   semi_join, slice_table, sort_table, sum_)
+                   groupby_rollup, inner_join, isin, join_aggregate,
+                   left_join, mean, semi_join, slice_table, sort_table, sum_)
 from ..ops import strings as S
 from ..ops import window as W
 from ..parquet import device_scan as decode  # device fast path, host fallback
@@ -95,6 +95,19 @@ def _group_sum(joined: Table, cols: list[str], key_names: list[str],
     return sort_table(out, list(range(len(key_names))))
 
 
+def _join_group_sum(lt: Table, rt: Table, left_on: int, right_on: int,
+                    cols: list[str], key_names: list[str],
+                    value_name: str) -> Table:
+    """Fused final join + GROUP BY keys, SUM(value) — the
+    ``join(...).groupby(...)`` tail executed through
+    ``ops.join_aggregate`` (no pair materialization).  ``cols`` names the
+    joined (left ++ right) schema, same contract as :func:`_group_sum`."""
+    out = join_aggregate(
+        lt, rt, left_on, right_on, [cols.index(k) for k in key_names],
+        [(cols.index(value_name), "sum")])
+    return sort_table(out, list(range(len(key_names))))
+
+
 def q3(tables: dict[str, Table], manufact_id: int = 436,
        moy: int = 11) -> Table:
     """SELECT d_year, i_brand_id, i_brand, sum(ss_ext_sales_price)
@@ -109,12 +122,12 @@ def q3(tables: dict[str, Table], manufact_id: int = 436,
         dd, _eq_scalar_mask(dd[_col(DATE_COLS, "d_moy")], moy))
     j1 = inner_join(ss, item_f, _col(SS_COLS, "ss_item_sk"),
                     _col(ITEM_COLS, "i_item_sk"))
-    # j1 columns: SS_COLS ++ ITEM_COLS
-    j2 = inner_join(j1, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
-                    _col(DATE_COLS, "d_date_sk"))
-    return _group_sum(j2, SS_COLS + ITEM_COLS + DATE_COLS,
-                      ["d_year", "i_brand_id", "i_brand"],
-                      "ss_ext_sales_price")
+    # j1 columns: SS_COLS ++ ITEM_COLS; the final join + groupby fuse
+    return _join_group_sum(j1, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
+                           _col(DATE_COLS, "d_date_sk"),
+                           SS_COLS + ITEM_COLS + DATE_COLS,
+                           ["d_year", "i_brand_id", "i_brand"],
+                           "ss_ext_sales_price")
 
 
 def q42(tables: dict[str, Table], manager_id: int = 1, year: int = 2000,
@@ -130,11 +143,11 @@ def q42(tables: dict[str, Table], manager_id: int = 1, year: int = 2000,
     dd_f = apply_boolean_mask(dd, dd_mask)
     j1 = inner_join(ss, item_f, _col(SS_COLS, "ss_item_sk"),
                     _col(ITEM_COLS, "i_item_sk"))
-    j2 = inner_join(j1, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
-                    _col(DATE_COLS, "d_date_sk"))
-    return _group_sum(j2, SS_COLS + ITEM_COLS + DATE_COLS,
-                      ["d_year", "i_category_id", "i_category"],
-                      "ss_ext_sales_price")
+    return _join_group_sum(j1, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
+                           _col(DATE_COLS, "d_date_sk"),
+                           SS_COLS + ITEM_COLS + DATE_COLS,
+                           ["d_year", "i_category_id", "i_category"],
+                           "ss_ext_sales_price")
 
 
 def q52(tables: dict[str, Table], moy: int = 12, year: int = 2001) -> Table:
@@ -146,11 +159,10 @@ def q52(tables: dict[str, Table], moy: int = 12, year: int = 2001) -> Table:
     j1 = inner_join(ss, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
                     _col(DATE_COLS, "d_date_sk"))
     cols1 = SS_COLS + DATE_COLS
-    j2 = inner_join(j1, tables["item"], cols1.index("ss_item_sk"),
-                    _col(ITEM_COLS, "i_item_sk"))
-    return _group_sum(j2, cols1 + ITEM_COLS,
-                      ["d_year", "i_brand_id", "i_brand"],
-                      "ss_ext_sales_price")
+    return _join_group_sum(j1, tables["item"], cols1.index("ss_item_sk"),
+                           _col(ITEM_COLS, "i_item_sk"), cols1 + ITEM_COLS,
+                           ["d_year", "i_brand_id", "i_brand"],
+                           "ss_ext_sales_price")
 
 
 def q55(tables: dict[str, Table], manager_id: int = 28) -> Table:
@@ -159,10 +171,10 @@ def q55(tables: dict[str, Table], manager_id: int = 28) -> Table:
     item_f = apply_boolean_mask(
         item, _eq_scalar_mask(item[_col(ITEM_COLS, "i_manager_id")],
                               manager_id))
-    j1 = inner_join(ss, item_f, _col(SS_COLS, "ss_item_sk"),
-                    _col(ITEM_COLS, "i_item_sk"))
-    return _group_sum(j1, SS_COLS + ITEM_COLS,
-                      ["i_brand_id", "i_brand"], "ss_ext_sales_price")
+    return _join_group_sum(ss, item_f, _col(SS_COLS, "ss_item_sk"),
+                           _col(ITEM_COLS, "i_item_sk"),
+                           SS_COLS + ITEM_COLS,
+                           ["i_brand_id", "i_brand"], "ss_ext_sales_price")
 
 
 def q_state_rollup(tables: dict[str, Table], state: str = "TN") -> Table:
@@ -199,11 +211,10 @@ def q7(tables: dict[str, Table], year: int = 2000) -> Table:
     j1 = inner_join(ss, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
                     _col(DATE_COLS, "d_date_sk"))
     cols1 = SS_COLS + DATE_COLS
-    j2 = inner_join(j1, item, cols1.index("ss_item_sk"),
-                    _col(ITEM_COLS, "i_item_sk"))
     cols = cols1 + ITEM_COLS
-    out = groupby_aggregate(
-        j2, [cols.index("i_item_id")],
+    out = join_aggregate(
+        j1, item, cols1.index("ss_item_sk"), _col(ITEM_COLS, "i_item_sk"),
+        [cols.index("i_item_id")],
         [(cols.index("ss_quantity"), "mean"),
          (cols.index("ss_list_price_cents"), "mean"),
          (cols.index("ss_sales_price_cents"), "mean")])
@@ -223,11 +234,11 @@ def q19(tables: dict[str, Table], year: int = 1999, moy: int = 11,
     dd_f = apply_boolean_mask(dd, dd_mask)
     j1 = inner_join(ss, item_f, _col(SS_COLS, "ss_item_sk"),
                     _col(ITEM_COLS, "i_item_sk"))
-    j2 = inner_join(j1, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
-                    _col(DATE_COLS, "d_date_sk"))
-    return _group_sum(j2, SS_COLS + ITEM_COLS + DATE_COLS,
-                      ["i_brand_id", "i_brand", "i_manufact_id"],
-                      "ss_ext_sales_price")
+    return _join_group_sum(j1, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
+                           _col(DATE_COLS, "d_date_sk"),
+                           SS_COLS + ITEM_COLS + DATE_COLS,
+                           ["i_brand_id", "i_brand", "i_manufact_id"],
+                           "ss_ext_sales_price")
 
 
 def q62(tables: dict[str, Table], year: int = 2000, qty_lo: int = 10,
@@ -238,11 +249,10 @@ def q62(tables: dict[str, Table], year: int = 2000, qty_lo: int = 10,
         ss, _range_mask(ss[_col(SS_COLS, "ss_quantity")], qty_lo, qty_hi))
     dd_f = apply_boolean_mask(
         dd, _eq_scalar_mask(dd[_col(DATE_COLS, "d_year")], year))
-    j = inner_join(ss_f, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
-                   _col(DATE_COLS, "d_date_sk"))
     cols = SS_COLS + DATE_COLS
-    out = groupby_aggregate(j, [cols.index("d_moy")],
-                            [(cols.index("ss_quantity"), "count")])
+    out = join_aggregate(ss_f, dd_f, _col(SS_COLS, "ss_sold_date_sk"),
+                         _col(DATE_COLS, "d_date_sk"), [cols.index("d_moy")],
+                         [(cols.index("ss_quantity"), "count")])
     return sort_table(out, [0])
 
 
@@ -262,11 +272,11 @@ def q65(tables: dict[str, Table], frac: float = 0.9) -> Table:
     (Q65 shape: aggregate, then compare each group against a global
     aggregate of the aggregate)."""
     ss, item = tables["store_sales"], tables["item"]
-    j = inner_join(ss, item, _col(SS_COLS, "ss_item_sk"),
-                   _col(ITEM_COLS, "i_item_sk"))
     cols = SS_COLS + ITEM_COLS
-    rev = groupby_aggregate(j, [cols.index("i_brand_id")],
-                            [(cols.index("ss_ext_sales_price"), "sum")])
+    rev = join_aggregate(ss, item, _col(SS_COLS, "ss_item_sk"),
+                         _col(ITEM_COLS, "i_item_sk"),
+                         [cols.index("i_brand_id")],
+                         [(cols.index("ss_ext_sales_price"), "sum")])
     # device scalar — a host pull here would both cost a sync and break
     # whole-query tracing (models/compiled.py); the comparison broadcasts
     threshold = mean(rev[1]) * frac
@@ -318,10 +328,10 @@ def q_like_brands(tables: dict[str, Table], pat: str = "#1",
     cat_ok = S.starts_with(item[_col(ITEM_COLS, "i_category")], cat_prefix)
     m = (brand_has.data.astype(bool) & cat_ok.data.astype(bool))
     item_f = apply_boolean_mask(item, m)
-    j = inner_join(ss, item_f, _col(SS_COLS, "ss_item_sk"),
-                   _col(ITEM_COLS, "i_item_sk"))
-    return _group_sum(j, SS_COLS + ITEM_COLS, ["i_category"],
-                      "ss_ext_sales_price")
+    return _join_group_sum(ss, item_f, _col(SS_COLS, "ss_item_sk"),
+                           _col(ITEM_COLS, "i_item_sk"),
+                           SS_COLS + ITEM_COLS, ["i_category"],
+                           "ss_ext_sales_price")
 
 
 def q_union_channels(tables: dict[str, Table]) -> Table:
@@ -336,8 +346,8 @@ def q_union_channels(tables: dict[str, Table]) -> Table:
     part_w = Table([ws[_col(WS_COLS, "ws_item_sk")],
                     ws[_col(WS_COLS, "ws_ext_sales_price")]])
     both = concat_tables([part_s, part_w])
-    j = inner_join(both, item, 0, _col(ITEM_COLS, "i_item_sk"))
-    return _group_sum(j, common + ITEM_COLS, ["i_category"], "price")
+    return _join_group_sum(both, item, 0, _col(ITEM_COLS, "i_item_sk"),
+                           common + ITEM_COLS, ["i_category"], "price")
 
 
 def q_lag_growth(tables: dict[str, Table]) -> Table:
@@ -391,19 +401,19 @@ def q_having(tables: dict[str, Table], min_total: float = 1000.0) -> Table:
     """GROUP BY brand HAVING SUM(price) > threshold (Q23 HAVING shape):
     aggregate, then filter on the aggregate.
 
-    Deliberately UN-projected: this is a full-fact join of all 16 columns.
-    Projection happens structurally — join outputs are deferred
-    (``ops.filter.gather`` returns ``LazyColumn``s), so only the three
-    columns the aggregate reads are ever gathered; the 13 unreferenced
-    ones (including every string column's multi-GB gather at SF1, which
-    used to OOM the worker) never materialize.
+    Deliberately UN-projected: the fused join+aggregate sees all 16 joined
+    columns by index but touches only the two the aggregate reads — with
+    ``ops.join_aggregate`` the join pairs themselves never materialize
+    (pre-fusion, projection happened structurally via ``LazyColumn``
+    deferral; the multi-GB string gathers that used to OOM the worker at
+    SF1 are likewise never issued).
     """
     ss, item = tables["store_sales"], tables["item"]
-    j = inner_join(ss, item, _col(SS_COLS, "ss_item_sk"),
-                   _col(ITEM_COLS, "i_item_sk"))
     cols = SS_COLS + ITEM_COLS
-    rev = groupby_aggregate(j, [cols.index("i_brand_id")],
-                            [(cols.index("ss_ext_sales_price"), "sum")])
+    rev = join_aggregate(ss, item, _col(SS_COLS, "ss_item_sk"),
+                         _col(ITEM_COLS, "i_item_sk"),
+                         [cols.index("i_brand_id")],
+                         [(cols.index("ss_ext_sales_price"), "sum")])
     keep = rev[1].values() > min_total
     return sort_table(apply_boolean_mask(rev, keep), [0])
 
@@ -445,10 +455,10 @@ def q_isin_states(tables: dict[str, Table],
     ss, store = tables["store_sales"], tables["store"]
     m = isin(store[_col(STORE_COLS, "s_state")], list(states))
     store_f = apply_boolean_mask(store, m)
-    j = inner_join(ss, store_f, _col(SS_COLS, "ss_store_sk"),
-                   _col(STORE_COLS, "s_store_sk"))
-    return _group_sum(j, SS_COLS + STORE_COLS, ["s_state"],
-                      "ss_ext_sales_price")
+    return _join_group_sum(ss, store_f, _col(SS_COLS, "ss_store_sk"),
+                           _col(STORE_COLS, "s_store_sk"),
+                           SS_COLS + STORE_COLS, ["s_state"],
+                           "ss_ext_sales_price")
 
 
 # ---------------------------------------------------------------------------
@@ -598,12 +608,12 @@ def q29_minmax(tables: dict[str, Table]) -> Table:
     """Selection-aggregate profile (Q29 shape): min/max/mean quantity per
     brand."""
     ss, item = tables["store_sales"], tables["item"]
-    j = inner_join(ss, item, _col(SS_COLS, "ss_item_sk"),
-                   _col(ITEM_COLS, "i_item_sk"))
     cols = SS_COLS + ITEM_COLS
     qi = cols.index("ss_quantity")
-    out = groupby_aggregate(j, [cols.index("i_brand_id")],
-                            [(qi, "min"), (qi, "max"), (qi, "mean")])
+    out = join_aggregate(ss, item, _col(SS_COLS, "ss_item_sk"),
+                         _col(ITEM_COLS, "i_item_sk"),
+                         [cols.index("i_brand_id")],
+                         [(qi, "min"), (qi, "max"), (qi, "mean")])
     return sort_table(out, [0])
 
 

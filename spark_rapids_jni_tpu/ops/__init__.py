@@ -10,6 +10,8 @@ from .groupby import (distinct, groupby_aggregate,  # noqa: F401
                       groupby_rollup)
 from .join import (anti_join, full_outer_join, inner_join,  # noqa: F401
                    join_indices, left_join, right_join, semi_join)
+from . import join_plan  # noqa: F401
+from .join_plan import join_aggregate  # noqa: F401
 from .scan import (cumulative_count, cumulative_max,  # noqa: F401
                    cumulative_min, cumulative_sum)
 from .reductions import max_, mean, min_, sum_, valid_count  # noqa: F401

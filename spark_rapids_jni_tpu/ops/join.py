@@ -1,12 +1,21 @@
-"""Equi-joins (libcudf hash-join analog, sort-merge formulation).
+"""Equi-joins (libcudf hash-join analog, join engine v2).
 
 TPU-first design choice: libcudf joins via GPU hash tables (open addressing,
-random scatter) — a poor fit for the VPU/MXU.  The XLA-idiomatic equivalent
-is **sort-probe**: sort the build side once, then binary-search every probe
-key (``searchsorted`` lowers to a vectorized compare tree).  Match expansion
-(1:N duplicates) is the only dynamically-sized step; its total is resolved
-with one scalar sync — the same two-phase discipline used everywhere else —
-then a statically-shaped gather materializes the pairs.
+random scatter) — a poor fit for the VPU/MXU.  Engine v2 probes through a
+planner-selected build-side index (``ops.join_plan``):
+
+* **dense direct lookup** — for dense integer key ranges (TPC-DS surrogate
+  keys) a ``(span,)`` CSR lookup table turns each probe into one gather;
+  with unique build keys the pair-expansion step is skipped entirely.
+* **sort-probe** — the fallback for sparse/float/string keys: sort the
+  build side once, binary-search every probe key (``searchsorted`` lowers
+  to a vectorized compare tree).
+
+Both index kinds return identical (lo, counts, row_ids) probe results, so
+this module's match-expansion tail — the only dynamically-sized step, its
+total resolved with one scalar sync per the two-phase discipline — is
+shared, and the engines produce bit-identical join indices.  Build-side
+indexes are cached on column-buffer identity (``join_plan.build_index``).
 
 Join keys: any fixed-width column.  Null keys never match (Spark equi-join
 semantics).  Multi-key joins pack via ``ops.hashing`` + verification gather,
@@ -53,25 +62,17 @@ def join_indices(left: Column, right: Column,
         # equality across both sides (ops.strings)
         from . import strings
         left, right = strings.encode_shared([left, right])
+    from . import join_plan
     ldata, lvalid = _key_with_nulls_last(left)
     rdata, rvalid = _key_with_nulls_last(right)
 
-    # sort the build (right) side; drop its null keys outright
-    r_order = jnp.argsort(rdata, stable=True)
-    r_sorted = rdata[r_order]
-    if rvalid is not None:
-        # stable-partition valid keys first by sorting (invalid → +inf rank)
-        rank = jnp.where(rvalid, 0, 1)[r_order]
-        rr = jnp.lexsort((r_sorted, rank))
-        r_order, r_sorted = r_order[rr], r_sorted[rr]
-        n_valid_r = syncs.scalar(jnp.sum(rvalid))
-        r_order, r_sorted = r_order[:n_valid_r], r_sorted[:n_valid_r]
-
-    lo = jnp.searchsorted(r_sorted, ldata, side="left")
-    hi = jnp.searchsorted(r_sorted, ldata, side="right")
-    counts = hi - lo
-    if lvalid is not None:
-        counts = jnp.where(lvalid, counts, 0)
+    # index the build (right) side — planner-selected layout, memoized on
+    # the key buffers' identity; null build keys are dropped outright
+    dense_ok = (join_plan.dense_eligible(right)
+                and join_plan.dense_eligible(left))
+    ix = join_plan.build_index(rdata, rvalid, dense_ok)
+    lo, counts = join_plan.probe_counts(ix, ldata, lvalid)
+    nr = ix.row_ids.shape[0]
 
     if how in ("semi", "anti"):
         # two-phase like every dynamic size (count sync → sized nonzero) so
@@ -79,6 +80,19 @@ def join_indices(left: Column, right: Column,
         m = (counts > 0) if how == "semi" else (counts == 0)
         k = syncs.scalar(jnp.sum(m))
         return jnp.nonzero(m, size=k)[0]
+
+    if ix.unique and nr > 0:
+        # unique build keys: each probe row matches ≤ 1 build row — no pair
+        # expansion, the match mask IS the output
+        pos = jnp.minimum(lo, nr - 1)
+        if how == "inner":
+            total = syncs.scalar(jnp.sum(counts))   # scalar sync (pair count)
+            left_idx = jnp.nonzero(counts > 0, size=total)[0]
+            right_idx = ix.row_ids[pos[left_idx]]
+            return left_idx, right_idx
+        left_idx = jnp.arange(ldata.shape[0], dtype=jnp.int64)
+        right_idx = jnp.where(counts > 0, ix.row_ids[pos], -1)
+        return left_idx, right_idx
 
     if how == "left":
         out_counts = jnp.maximum(counts, 1)   # unmatched keep one row
@@ -93,12 +107,12 @@ def join_indices(left: Column, right: Column,
                                 side="right") - 1
     within = pair_ids - starts.astype(jnp.int64)[left_idx]
     matched = within < counts[left_idx]
-    if r_sorted.shape[0] == 0:
+    if nr == 0:
         right_idx = jnp.full(left_idx.shape, -1, dtype=jnp.int64)
     else:
         r_pos = lo[left_idx] + jnp.where(matched, within, 0)
         right_idx = jnp.where(
-            matched, r_order[jnp.minimum(r_pos, r_sorted.shape[0] - 1)], -1)
+            matched, ix.row_ids[jnp.minimum(r_pos, nr - 1)], -1)
     return left_idx, right_idx
 
 

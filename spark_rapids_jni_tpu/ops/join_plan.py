@@ -1,0 +1,341 @@
+"""Join planning: build-side indexes, dense-key direct lookup, index
+caching, and join→aggregate fusion (join engine v2).
+
+Join-strategy heuristic (the planner)
+-------------------------------------
+:func:`build_index` inspects the build (right) side once and picks between
+two physical index layouts.  Both expose the same probe interface —
+``(lo, counts)`` positions into a key-sorted ``row_ids`` array — so the
+match-expansion tail in ``ops.join`` is shared and the engines produce
+bit-identical indices:
+
+* **dense** — eligible when both key columns are fixed-width integer-kind
+  (ints, dates/timestamps, decimal32/64 raw payloads, dictionary codes
+  from string keys; NOT float bit-keys, decimal128 limbs, or uint64) and
+  the observed build key span ``kmax - kmin + 1`` satisfies
+  ``span <= max(DENSE_SPAN_FACTOR * n_valid, DENSE_SPAN_FLOOR)`` and
+  ``span <= DENSE_SPAN_CAP``.  A ``(span,)`` CSR lookup table
+  (slot → start offset + run length into key-sorted ``row_ids``) is
+  materialized once; probing is one subtract + clip + two gathers —
+  no ``searchsorted`` compare tree.  TPC-DS surrogate keys are contiguous
+  integers, so the star joins all take this path.  When every slot holds
+  at most one build row (``unique``) the index is built by direct scatter
+  (no sort at all) and ``ops.join`` skips pair expansion entirely.
+* **sorted** — the fallback for sparse/float/string/128-bit keys: the
+  original sort-probe (stable key sort + two ``searchsorted``).
+
+The span bounds (``kmin``/``kmax``), the valid-row count, and the
+uniqueness bit all resolve through ``syncs.scalar``, so the planner's
+branch decisions replay identically under ``models/compiled.py``
+capture/replay, and the compiled-plan staleness guard re-derives them
+against refreshed data (a key-range drift raises ``StaleTapeError``
+instead of silently probing the wrong window).
+
+Build-side index cache
+----------------------
+Indexes are memoized on the key buffers' device-array identity
+(``syncs.memo_get/put`` — weakref'd, entries drop with the arrays, and
+the memo is automatically disabled under capture/replay so tapes stay
+aligned).  A dimension table is therefore sorted/indexed ONCE per process
+and reused across every join of every query in a suite run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..column import Column, Table, force_column
+from ..utils import syncs
+
+DENSE_SPAN_FACTOR = 2
+DENSE_SPAN_FLOOR = 4096
+DENSE_SPAN_CAP = 1 << 23
+
+_FORCED: Optional[str] = None      # None | "dense" | "sorted"
+
+
+def forced_engine() -> Optional[str]:
+    f = _FORCED or os.environ.get("SRJT_JOIN_ENGINE")
+    return f if f in ("dense", "sorted") else None
+
+
+@contextlib.contextmanager
+def force_engine(kind: Optional[str]):
+    """Pin the physical join engine ("dense" / "sorted"; None restores the
+    planner heuristic) — benchmark/test hook, not a production API."""
+    global _FORCED
+    old, _FORCED = _FORCED, kind
+    try:
+        yield
+    finally:
+        _FORCED = old
+
+
+class BuildIndex(NamedTuple):
+    """Physical index over the build side's valid (non-null-key) rows."""
+    kind: str                            # "dense" | "sorted"
+    n_valid: int                         # valid build rows (static)
+    row_ids: jnp.ndarray                 # [n_valid] key-sorted, stable
+    sorted_keys: Optional[jnp.ndarray]   # [n_valid] (sorted kind only)
+    kmin: int                            # dense: lookup-window base key
+    span: int                            # dense: lut length (0 if sorted)
+    lut_lo: Optional[jnp.ndarray]        # [span] slot → start into row_ids
+    lut_cnt: Optional[jnp.ndarray]       # [span] slot → run length
+    unique: bool                         # dense: every slot holds ≤ 1 row
+
+
+def dense_eligible(col: Column) -> bool:
+    """Key dtypes the direct-lookup window arithmetic is exact for."""
+    dt = col.dtype
+    if dt.is_variable_width or dt.is_nested:
+        return False
+    if dt.id in (T.TypeId.FLOAT32, T.TypeId.FLOAT64, T.TypeId.DECIMAL128):
+        return False
+    sd = np.dtype(dt.storage)
+    if sd.kind not in "iu":
+        return False
+    return not (sd.kind == "u" and sd.itemsize == 8)
+
+
+def build_index(data: jnp.ndarray, valid, dense_ok: bool) -> BuildIndex:
+    """Index the build side, memoized on the key buffers' identity."""
+    forced = forced_engine()
+    tag = f"join_build_index:{forced or 'auto'}"
+    key_arrays = (data,) if valid is None else (data, valid)
+    hit = syncs.memo_get(tag, key_arrays)
+    if hit is not None:
+        return hit
+    ix = _build_index(data, valid, dense_ok and forced != "sorted",
+                      forced == "dense")
+    syncs.memo_put(tag, key_arrays, ix)
+    return ix
+
+
+def _key_sorted_order(data, valid, n_valid: int):
+    """Valid build rows in stable key-sorted order (ties keep original row
+    order — the exact ``r_order`` the sort-probe engine produces)."""
+    order = jnp.argsort(data, stable=True)
+    if valid is None:
+        return order, data[order]
+    skeys = data[order]
+    rank = jnp.where(valid, 0, 1)[order]
+    rr = jnp.lexsort((skeys, rank))       # valid first, then key, stable
+    return order[rr][:n_valid], skeys[rr][:n_valid]
+
+
+def _build_index(data, valid, try_dense: bool, must_dense: bool):
+    n = int(data.shape[0])
+    n_valid = n if valid is None else syncs.scalar(jnp.sum(valid))
+    kmin = span = 0
+    dense = False
+    if try_dense and n_valid > 0:
+        info = np.iinfo(np.dtype(data.dtype))
+        dmin = data if valid is None else jnp.where(valid, data, info.max)
+        dmax = data if valid is None else jnp.where(valid, data, info.min)
+        kmin = syncs.scalar(jnp.min(dmin))
+        span = syncs.scalar(jnp.max(dmax)) - kmin + 1
+        limit = DENSE_SPAN_CAP if must_dense else min(
+            max(DENSE_SPAN_FACTOR * n_valid, DENSE_SPAN_FLOOR),
+            DENSE_SPAN_CAP)
+        dense = span <= limit
+    if not dense:
+        order, skeys = _key_sorted_order(data, valid, n_valid)
+        return BuildIndex("sorted", n_valid, order, skeys, 0, 0, None, None,
+                          False)
+    slot64 = data.astype(jnp.int64) - kmin
+    ok = jnp.ones(n, jnp.bool_) if valid is None else valid
+    slot = jnp.clip(slot64, 0, span - 1).astype(jnp.int32)
+    lut_cnt = jnp.zeros(span, jnp.int32).at[slot].add(ok.astype(jnp.int32))
+    lut_lo = (jnp.cumsum(lut_cnt) - lut_cnt).astype(jnp.int32)
+    unique = syncs.scalar(jnp.max(lut_cnt)) <= 1
+    if unique:
+        # no sort anywhere: each valid row scatters straight to its slot
+        tgt = jnp.where(ok, lut_lo[slot].astype(jnp.int64),
+                        jnp.int64(n_valid))
+        row_ids = jnp.zeros(n_valid, jnp.int64).at[tgt].set(
+            jnp.arange(n, dtype=jnp.int64), mode="drop")
+    else:
+        row_ids, _ = _key_sorted_order(data, valid, n_valid)
+    return BuildIndex("dense", n_valid, row_ids, None, int(kmin), int(span),
+                      lut_lo, lut_cnt, bool(unique))
+
+
+def probe_counts(ix: BuildIndex, ldata, lvalid):
+    """Per probe row: (first match position into ``ix.row_ids``, match
+    count).  ``lo`` is unspecified where ``counts == 0`` (callers guard,
+    as the sort-probe tail always has)."""
+    if ix.kind == "dense":
+        d = ldata.astype(jnp.int64) - ix.kmin
+        in_r = (d >= 0) & (d < ix.span)
+        if lvalid is not None:
+            in_r = in_r & lvalid
+        slot = jnp.clip(d, 0, max(ix.span - 1, 0)).astype(jnp.int32)
+        counts = jnp.where(in_r, ix.lut_cnt[slot], 0)
+        return ix.lut_lo[slot], counts
+    lo = jnp.searchsorted(ix.sorted_keys, ldata, side="left")
+    hi = jnp.searchsorted(ix.sorted_keys, ldata, side="right")
+    counts = hi - lo
+    if lvalid is not None:
+        counts = jnp.where(lvalid, counts, 0)
+    return lo, counts
+
+
+# --- join→aggregate fusion ---------------------------------------------------
+
+
+def _take_col(col: Column, idx) -> Column:
+    from .filter import _gather_column
+    return _gather_column(force_column(col), idx)
+
+
+def join_aggregate(left: Table, right: Table, left_on: int, right_on: int,
+                   group_keys: Sequence[int],
+                   aggs: Sequence[tuple[int, str]]) -> Table:
+    """``groupby_aggregate(inner_join(left, right, left_on, right_on),
+    group_keys, aggs)`` without materializing the join pairs.
+
+    ``group_keys`` and the agg value indices address the joined
+    (left ++ right) schema.  Fused shapes:
+
+    * **unique build side** (the TPC-DS star shape — fact ⋈ dimension on a
+      surrogate PK): matched probe rows ARE the joined rows, so only the
+      group-key/value columns are gathered (one compaction sync) and fed
+      straight into ``ops.groupby``'s segment reductions — no pair
+      expansion, no wide joined table.
+    * **probe-side-only columns** over a duplicated build side: each probe
+      row's match count becomes a weight (sum/count/mean weight their
+      contributions; min/max ignore multiplicity), so the pairs still
+      never materialize.
+
+    Anything else falls back to the materialized join + groupby (identical
+    result either way — differentially tested in tests/test_join_v2.py).
+    """
+    from . import strings
+    from .groupby import groupby_aggregate
+    from .join import _key_with_nulls_last, inner_join
+
+    nl = left.num_columns
+    lcol, rcol = left[left_on], right[right_on]
+    if lcol.dtype.is_variable_width or rcol.dtype.is_variable_width:
+        lcol, rcol = strings.encode_shared([lcol, rcol])
+    ldata, lvalid = _key_with_nulls_last(lcol)
+    rdata, rvalid = _key_with_nulls_last(rcol)
+    dense_ok = dense_eligible(rcol) and dense_eligible(lcol)
+    ix = build_index(rdata, rvalid, dense_ok)
+
+    needed = list(group_keys) + [vi for vi, _ in aggs]
+    if ix.unique:
+        lo, counts = probe_counts(ix, ldata, lvalid)
+        m = counts > 0
+        k = syncs.scalar(jnp.sum(m))
+        li = jnp.nonzero(m, size=k)[0]
+        ri = ix.row_ids[jnp.minimum(lo[li], max(ix.n_valid - 1, 0))]
+        cols = [_take_col(left[ci], li) if ci < nl
+                else _take_col(right[ci - nl], ri) for ci in needed]
+        nk = len(group_keys)
+        return groupby_aggregate(
+            Table(cols), list(range(nk)),
+            [(nk + i, agg) for i, (_, agg) in enumerate(aggs)])
+
+    if (group_keys and all(ci < nl for ci in needed)
+            and _weighted_ok([left[ci] for ci in group_keys],
+                             [(left[vi], agg) for vi, agg in aggs])):
+        lo, counts = probe_counts(ix, ldata, lvalid)
+        m = counts > 0
+        k = syncs.scalar(jnp.sum(m))
+        li = jnp.nonzero(m, size=k)[0]
+        w = counts.astype(jnp.int64)[li]
+        return _weighted_groupby(
+            [_take_col(left[ci], li) for ci in group_keys],
+            [(_take_col(left[vi], li), agg) for vi, agg in aggs], w)
+
+    j = inner_join(left, right, left_on, right_on)
+    return groupby_aggregate(j, list(group_keys), list(aggs))
+
+
+def _weighted_ok(key_cols, val_aggs) -> bool:
+    for c in key_cols:
+        dt = c.dtype
+        if (dt.is_variable_width or dt.is_nested
+                or dt.id in (T.TypeId.FLOAT64, T.TypeId.DECIMAL128)):
+            return False
+    for c, agg in val_aggs:
+        dt = c.dtype
+        if dt.is_variable_width or dt.is_nested or dt.id == T.TypeId.DECIMAL128:
+            return False
+        if agg not in ("sum", "count", "mean", "min", "max"):
+            return False
+        if dt.id == T.TypeId.FLOAT64 and agg in ("min", "max"):
+            return False          # bit-exact selection needs the full path
+    return True
+
+
+def _weighted_groupby(key_cols, val_aggs, w) -> Table:
+    """Groupby over matched probe rows where row ``i`` stands for ``w[i]``
+    identical joined pairs — mirrors ``ops.groupby`` semantics/dtypes for
+    the shapes :func:`_weighted_ok` admits."""
+    from .groupby import (_agg_out_dtype, _agg_segment, _cast_res,
+                          _empty_result, _segment_ids, _take_rows)
+    from .sort import order_by
+
+    nk = len(key_cols)
+    sub = Table(key_cols + [c for c, _ in val_aggs])
+    if sub.num_rows == 0:
+        return _empty_result(sub, list(range(nk)),
+                             [(nk + i, a) for i, (_, a) in
+                              enumerate(val_aggs)])
+    order = order_by(Table(key_cols), list(range(nk)))
+    skeys = [_take_rows(c, order) for c in key_cols]
+    seg_ids = _segment_ids([c.data for c in skeys],
+                           [c.validity for c in skeys])
+    ns = syncs.scalar(seg_ids[-1]) + 1
+    n = order.shape[0]
+    head_pos = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int32), seg_ids,
+                                   ns)
+    out_cols = [_take_rows(c, head_pos) for c in skeys]
+    ws = w[order]
+    for col, agg in val_aggs:
+        valid = None if col.validity is None else col.validity[order]
+        if agg == "count":
+            ones = ws if valid is None else jnp.where(valid, ws, 0)
+            res = jax.ops.segment_sum(ones, seg_ids, ns)
+            dt = _agg_out_dtype(col.dtype, agg)
+            out_cols.append(Column(dt, res.astype(dt.storage)))
+            continue
+        vals = col.values()[order]
+        if agg in ("sum", "mean"):
+            kind = col.dtype.storage.kind
+            acc = vals.astype(jnp.float64 if kind == "f" else jnp.int64)
+            acc = acc if valid is None else jnp.where(valid, acc, 0)
+            s = jax.ops.segment_sum(acc * ws.astype(acc.dtype), seg_ids, ns)
+            if agg == "sum":
+                dt = _agg_out_dtype(col.dtype, agg)
+                out_cols.append(Column.from_values(dt, _cast_res(s, dt)))
+                continue
+            cnt = jax.ops.segment_sum(
+                ws if valid is None else jnp.where(valid, ws, 0),
+                seg_ids, ns)
+            res = s.astype(jnp.float64) / jnp.maximum(cnt, 1).astype(
+                jnp.float64)
+            dt = _agg_out_dtype(col.dtype, agg)
+            out_cols.append(Column.from_values(dt, _cast_res(res, dt)))
+            continue
+        # min/max: pair multiplicity is irrelevant — plain segment select
+        res = _agg_segment(vals, valid, seg_ids, agg, ns,
+                           col.dtype.storage.kind)
+        if valid is not None:
+            cnt = _agg_segment(vals, valid, seg_ids, "count", ns,
+                               col.dtype.storage.kind)
+            out_cols.append(Column.from_values(
+                col.dtype, _cast_res(res, col.dtype), validity=cnt > 0))
+        else:
+            out_cols.append(Column.from_values(col.dtype,
+                                               _cast_res(res, col.dtype)))
+    return Table(out_cols)

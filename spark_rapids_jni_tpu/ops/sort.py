@@ -51,6 +51,14 @@ def order_by(table: Table, keys: Sequence[int],
                 # place it last natively, but negation keeps NaN last, so
                 # descending needs an explicit NaN-first rank lane
                 key_lanes.append(jnp.where(jnp.isnan(data), 0, 1))
+        if col.validity is not None:
+            # null rows must TIE on this key (SQL: all nulls equal under
+            # ORDER BY) so lower-priority keys order them — zero the stale
+            # payload, else it ranks the null block and splits downstream
+            # groupby segments
+            key_lanes = [jnp.where(col.validity, lane,
+                                   jnp.zeros((), lane.dtype))
+                         for lane in key_lanes]
         lanes.extend(key_lanes)
         if col.validity is not None:
             # the rank lane always sorts ascending, independent of the data

@@ -27,6 +27,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+try:                                    # jax >= 0.5 top-level name
+    _shard_map = jax.shard_map
+except AttributeError:                  # 0.4.x keeps it in experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from ..column import Column
 from ..ops import strings as S
 
@@ -109,7 +114,7 @@ def _compiled_star_agg(mesh, num_groups: int, axis_name: str):
     """jitted program cached on (mesh, num_groups, axis) — rebuilding the
     shard_map wrapper per call would retrace every invocation."""
     P = jax.sharding.PartitionSpec
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(_local_star_agg, num_groups, axis_name),
         mesh=mesh,
         in_specs=(P(), P(), P(axis_name), P(axis_name)),

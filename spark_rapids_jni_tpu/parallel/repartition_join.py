@@ -25,6 +25,11 @@ TPU-first design notes:
   (searchsorted + segment-add), then distributed to every build row of the
   run — each (fact, build) pair contributes exactly once without ever
   materializing the expanded pairs;
+* dense integer build keys (join engine v2, the TPC-DS surrogate-key case)
+  skip the sort entirely: each shard scatter-adds fact values into a
+  ``(span,)`` slot accumulator addressed by ``key - key_min`` and build
+  rows gather their slot — the auto path detects this from the build key
+  range (``JoinAggSpec.key_span``);
 * capacities are sized automatically by a count pass
   (:func:`repartition_join_agg_auto`) — the same two-phase discipline as the
   reference's batch sizing (``row_conversion.cu:1460-1539``) — so bucket
@@ -43,6 +48,11 @@ from typing import NamedTuple, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+try:                                    # jax ≥ 0.5 top-level name
+    _shard_map = jax.shard_map
+except AttributeError:                  # 0.4.x keeps it in experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
 
 from ..ops.hashing import murmur3_32, hash_partition
 from ..rowconv.convert import (_to_rows_fixed_words, _from_rows_fixed_words)
@@ -65,6 +75,11 @@ class JoinAggSpec(NamedTuple):
     num_groups: int
     fact_capacity: int     # per-destination bucket rows, fact side
     build_capacity: int    # per-destination bucket rows, build side
+    # dense-key direct lookup (join engine v2): when key_span > 0 the local
+    # probe indexes a (span,) slot accumulator with key - key_min instead of
+    # sorting + searchsorted.  0 (the default) keeps the sort-merge probe.
+    key_min: int = 0
+    key_span: int = 0
 
 
 def _shuffle_side(layout, datas, valid, key, axis_name, capacity, P):
@@ -93,6 +108,42 @@ def _local_join_agg(spec: JoinAggSpec, axis_name, num_partitions,
     bdatas, bvalidm, bmask, bdrop = _shuffle_side(
         lb, build_datas, build_valid, build_datas[spec.build_key_idx],
         axis_name, spec.build_capacity, num_partitions)
+
+    if spec.key_span > 0:
+        # dense-key fast path (the ops/join_plan.py heuristic applied per
+        # shard): slot = key - key_min addresses a (span,) accumulator
+        # directly — no build sort, no searchsorted.  The shuffle already
+        # guarantees all rows of a key share a chip, so a slot read by a
+        # live build row holds exactly the fact rows with that key.  JAX
+        # wraps NEGATIVE scatter indices even under mode="drop" (only
+        # OOB-high drops), so bad rows are where()-routed to slot span.
+        span = spec.key_span
+        fkey = fdatas[spec.fact_key_idx]
+        flive = fmask & fvalidm[:, spec.fact_key_idx]
+        fd = fkey.astype(jnp.int64) - spec.key_min
+        f_ok = flive & (fd >= 0) & (fd < span)
+        fslot = jnp.where(f_ok, fd, jnp.int64(span))
+        val = fdatas[spec.fact_value_idx].astype(jnp.int64)
+        fval_ok = fvalidm[:, spec.fact_value_idx]
+        slot_sums = jnp.zeros(span + 1, jnp.int64).at[fslot].add(
+            jnp.where(f_ok & fval_ok, val, 0), mode="drop")[:span]
+        slot_cnts = jnp.zeros(span + 1, jnp.int32).at[fslot].add(
+            f_ok.astype(jnp.int32), mode="drop")[:span]
+
+        bkey = bdatas[spec.build_key_idx]
+        blive = bmask & bvalidm[:, spec.build_key_idx]
+        bd = bkey.astype(jnp.int64) - spec.key_min
+        b_ok = blive & (bd >= 0) & (bd < span)
+        bslot = jnp.clip(bd, 0, span - 1)
+        g = jnp.where(b_ok, bdatas[spec.build_group_idx].astype(jnp.int32),
+                      jnp.int32(spec.num_groups))
+        sums = jnp.zeros(spec.num_groups, jnp.int64).at[g].add(
+            jnp.where(b_ok, slot_sums[bslot], 0), mode="drop")
+        cnts = jnp.zeros(spec.num_groups, jnp.int32).at[g].add(
+            jnp.where(b_ok, slot_cnts[bslot], 0), mode="drop")
+        return (jax.lax.psum(sums, axis_name),
+                jax.lax.psum(cnts, axis_name),
+                jax.lax.psum(fdrop + bdrop, axis_name))
 
     # build side: dead/null-key slots get a max sentinel AND sort strictly
     # after any live row with the same value (secondary dead-flag lane), so
@@ -149,7 +200,7 @@ def _compiled_join_agg(mesh, spec: JoinAggSpec, axis_name):
     nf, nb = len(spec.fact_schema), len(spec.build_schema)
     axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
     num_partitions = int(np.prod([mesh.shape[a] for a in axes]))
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(_local_join_agg, spec, axis_name, num_partitions),
         mesh=mesh,
         in_specs=(tuple(P(axis_name) for _ in range(nf)), P(axis_name),
@@ -197,7 +248,7 @@ def _compiled_bucket_need(mesh, axis_name):
     P = jax.sharding.PartitionSpec
     axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
     num_partitions = int(np.prod([mesh.shape[a] for a in axes]))
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(_local_bucket_need, axis_name, num_partitions),
         mesh=mesh, in_specs=(P(axis_name), P(axis_name)),
         out_specs=(P(), P()))
@@ -228,16 +279,42 @@ def repartition_join_agg_auto(mesh: jax.sharding.Mesh,
     """:func:`repartition_join_agg` with automatic two-phase capacity
     sizing: a count pass measures the true per-destination bucket maxima
     (one tiny sync), capacities are bucketed for compile-cache reuse, and
-    the sized program runs with overflow structurally impossible."""
+    the sized program runs with overflow structurally impossible.
+
+    The count pass also inspects the build key range and, when it is dense
+    (``ops/join_plan.py`` heuristic: span ≤ max(2·n, 4096), capped), sets
+    ``key_min``/``key_span`` so every shard probes by direct lookup.
+    ``key_min`` is floored and the span bucketed so nearby datasets share a
+    compile-cache entry."""
     need_fn = _compiled_bucket_need(mesh, axis_name)
     nf, nb = need_fn(fact_datas[fact_key_idx], build_datas[build_key_idx])
     needs = np.asarray(jnp.stack([nf, nb]))      # ONE host sync, two scalars
+    key_min = key_span = 0
+    bk = build_datas[build_key_idx]
+    bdt = np.dtype(bk.dtype)
+    if bdt.kind == "i" or (bdt.kind == "u" and bdt.itemsize < 8):
+        from ..ops import join_plan
+        bv = build_valid[:, build_key_idx]
+        info = np.iinfo(bdt)
+        stats = np.asarray(jnp.stack([          # one more sync, 3 scalars
+            jnp.sum(bv).astype(jnp.int64),
+            jnp.min(jnp.where(bv, bk, info.max)).astype(jnp.int64),
+            jnp.max(jnp.where(bv, bk, info.min)).astype(jnp.int64)]))
+        nvalid, kmin, kmax = (int(s) for s in stats)
+        if nvalid > 0:
+            limit = min(max(join_plan.DENSE_SPAN_FACTOR * nvalid,
+                            join_plan.DENSE_SPAN_FLOOR),
+                        join_plan.DENSE_SPAN_CAP)
+            if kmax - kmin + 1 <= limit:
+                key_min = (kmin // 4096) * 4096
+                key_span = _bucket_capacity(kmax - key_min + 1)
     spec = JoinAggSpec(
         fact_schema=tuple(fact_schema), build_schema=tuple(build_schema),
         fact_key_idx=fact_key_idx, build_key_idx=build_key_idx,
         build_group_idx=build_group_idx, fact_value_idx=fact_value_idx,
         num_groups=num_groups,
         fact_capacity=_bucket_capacity(needs[0]),
-        build_capacity=_bucket_capacity(needs[1]))
+        build_capacity=_bucket_capacity(needs[1]),
+        key_min=key_min, key_span=key_span)
     return repartition_join_agg(mesh, spec, fact_datas, fact_valid,
                                 build_datas, build_valid, axis_name)

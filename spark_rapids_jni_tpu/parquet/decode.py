@@ -580,10 +580,26 @@ class _Leaf:
         return _PHYS_DT[phys]
 
 
+class NestedDecodeUnsupported(NotImplementedError):
+    """The file's schema needs nested decode (list-of-list or MAP).
+
+    Raised while walking the footer schema — BEFORE any chunk I/O or page
+    decode — so callers see the offending column paths up front instead of
+    a failure deep inside the decode pipeline.  The footer pruner
+    deliberately keeps accepting these schemas (other columns of the same
+    file remain readable once projection prunes the nested ones)."""
+
+
 def _leaf_schema_elements(meta: Struct) -> list[_Leaf]:
-    """Depth-first walk: leaves with def/rep depths (Dremel levels)."""
+    """Depth-first walk: leaves with def/rep depths (Dremel levels).
+
+    Raises :class:`NestedDecodeUnsupported` for schema shapes the decoder
+    cannot produce columns for: repetition depth > 1 (lists of lists) and
+    MAP/MAP_KEY_VALUE groups (their key/value leaves would alias one
+    output column name)."""
     schema = meta.get(FMD.SCHEMA).values
     out: list[_Leaf] = []
+    bad: list[str] = []
 
     def walk(idx: int, depth_def: int, depth_rep: int, d_list: int,
              prefix: str):
@@ -596,9 +612,12 @@ def _leaf_schema_elements(meta: Struct) -> list[_Leaf]:
         my_def = depth_def + (1 if rep in (1, 2) else 0)
         my_rep = depth_rep + (1 if rep == 2 else 0)
         my_dlist = my_def if rep == 2 else d_list
-        if my_rep > 1:
-            raise NotImplementedError("nested lists (max_rep > 1)")
         path = f"{prefix}.{name}" if prefix else name
+        ct = elem.get(SE.CONVERTED_TYPE)
+        if my_rep > 1:
+            bad.append(f"{path} (nested lists, max_rep > 1)")
+        elif n and ct in (CT_MAP, CT_MAP_KEY_VALUE):
+            bad.append(f"{path} (MAP)")
         idx += 1
         if n == 0:
             out.append(_Leaf(elem, my_def, my_rep, my_dlist, path))
@@ -611,6 +630,9 @@ def _leaf_schema_elements(meta: Struct) -> list[_Leaf]:
     root_children = schema[0].get(SE.NUM_CHILDREN, 0) or 0
     for _ in range(root_children):
         idx = walk(idx, 0, 0, 0, "")
+    if bad:
+        raise NestedDecodeUnsupported(
+            "nested decode unsupported: " + ", ".join(bad))
     return out
 
 

@@ -56,6 +56,7 @@ def test_compiled_matches_eager(tables, qname):
     _tables_equal(out2, cq.expected)
 
 
+@pytest.mark.slow
 def test_stale_tape_raises(tables):
     """VERDICT r4 weak #6: re-running a compiled plan against refreshed
     data whose true resolved sizes differ (same shapes, different join
@@ -77,6 +78,7 @@ def test_stale_tape_raises(tables):
     _tables_equal(out, cq2.expected)
 
 
+@pytest.mark.slow
 def test_replay_detects_divergence(tables):
     cq = compile_query(tpcds.QUERIES["q3"], tables)
     # a tape for a different plan must not silently misresolve

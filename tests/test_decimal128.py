@@ -252,6 +252,7 @@ class TestJcudfRows:
         assert back[1].to_pylist() == t[1].to_pylist()
         assert back[0].to_pylist() == t[0].to_pylist()
 
+    @pytest.mark.slow
     def test_roundtrip_with_strings(self):
         from spark_rapids_jni_tpu.rowconv import (convert_to_rows,
                                                   convert_from_rows)

@@ -63,6 +63,7 @@ def test_device_available_in_python_process():
     assert lib.srjt_device_available() == 1
 
 
+@pytest.mark.slow
 def test_to_rows_device_matches_host_engine():
     t, _ = _mixed_table()
     host = lib.srjt_to_rows(t)
@@ -75,6 +76,7 @@ def test_to_rows_device_matches_host_engine():
     lib.srjt_table_free(t)
 
 
+@pytest.mark.slow
 def test_from_rows_device_roundtrip():
     t, (ints, offs, chars, valid, longs) = _mixed_table()
     rows = lib.srjt_to_rows_device(t)
@@ -100,6 +102,7 @@ def test_from_rows_device_roundtrip():
     lib.srjt_table_free(back)
 
 
+@pytest.mark.slow
 def test_srjt_device_kill_switch(monkeypatch):
     # SRJT_DEVICE=0 is the operator escape hatch forcing the host engine
     # (same convention as the SRJT_PALLAS dispatch toggle); getenv is read

@@ -62,6 +62,7 @@ def test_nulls_def_level_expansion():
     assert_tables_match(device_scan.scan_table(raw), decode.read_table(raw))
 
 
+@pytest.mark.slow
 def test_mixed_fallback_columns():
     # strings + date32 + f64: strings fall back to the host decoder, the
     # rest ride the device path — column order must be preserved
@@ -185,6 +186,7 @@ def test_non_decimal_flba_falls_back():
 
 
 @pytest.mark.parametrize("compression", ["NONE", "SNAPPY"])
+@pytest.mark.slow
 def test_plain_strings_on_device(compression):
     """VERDICT r3 #2 done-criterion: a string column decoded ON DEVICE —
     scan_column_device must handle the PLAIN string chunk itself (no host
@@ -217,6 +219,7 @@ def test_plain_booleans_on_device():
                         decode.read_table(raw))
 
 
+@pytest.mark.slow
 def test_device_scan_strings_not_fallback(monkeypatch):
     """Prove the string column goes through the DEVICE path: poison the
     host per-column decoder and scan anyway."""
@@ -243,6 +246,7 @@ def _str_cols_equal(a, b):
 
 @pytest.mark.parametrize("compression", ["NONE", "SNAPPY"])
 @pytest.mark.parametrize("with_nulls", [False, True])
+@pytest.mark.slow
 def test_dict_strings_on_device(compression, with_nulls):
     """Dictionary-encoded strings — the dominant real-world string
     encoding — must decode byte-exactly through the device path."""
@@ -263,6 +267,7 @@ def test_dict_strings_on_device(compression, with_nulls):
                                   np.asarray(host.columns[1].data))
 
 
+@pytest.mark.slow
 def test_dict_strings_not_fallback(monkeypatch):
     """Prove dictionary strings decode on the DEVICE path (no host column
     decoder involvement)."""
@@ -365,6 +370,7 @@ def test_rle_device_differential():
     assert R.parse_runs(b"", 25, 10) is None
 
 
+@pytest.mark.slow
 def test_dict_strings_mostly_empty():
     """Short/empty dictionary entries: the adaptive group size must keep
     the device path engaged (round-5 regression: g=8 blew the P cap)."""
@@ -377,6 +383,7 @@ def test_dict_strings_mostly_empty():
     _str_cols_equal(dev.columns[0], host.columns[0])
 
 
+@pytest.mark.slow
 def test_fused_scan_matches_per_column(monkeypatch):
     """The per-file fused program must produce exactly what the
     per-column dispatches produce."""

@@ -1,0 +1,301 @@
+"""Join engine v2 differential tests (ops/join_plan.py).
+
+Three contracts:
+* the dense direct-lookup engine produces BIT-IDENTICAL join indices to
+  the sort-probe engine on every overlapping input (null keys, duplicate
+  build keys, empty build side, inner/left/semi/anti) — pinned with
+  ``join_plan.force_engine``;
+* the build-side index cache returns the same physical index (and thus
+  identical join indices) when the same key buffers join again;
+* ``join_aggregate`` fusion (unique-build, weighted, and fallback paths)
+  matches the unfused ``groupby_aggregate(inner_join(...))`` exactly.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+import jax.numpy as jnp
+
+import spark_rapids_jni_tpu as sr
+from spark_rapids_jni_tpu import Column, Table
+from spark_rapids_jni_tpu import ops
+from spark_rapids_jni_tpu.ops import join_plan
+from spark_rapids_jni_tpu.ops.join import join_indices
+
+RNG = np.random.default_rng(42)
+
+
+def int_col(vals, validity=None, dt=None):
+    return Column.from_numpy(np.asarray(vals), dt, validity)
+
+
+def _both_engines(left, right, how):
+    with join_plan.force_engine("dense"):
+        d = join_indices(left, right, how)
+    with join_plan.force_engine("sorted"):
+        s = join_indices(left, right, how)
+    return d, s
+
+
+def _assert_same(d, s):
+    if isinstance(d, tuple):
+        for a, b in zip(d, s):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    else:
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(s))
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+def test_dense_matches_sorted_random(how):
+    lk = RNG.integers(0, 400, 3000, dtype=np.int64)
+    rk = RNG.integers(0, 400, 500, dtype=np.int64)   # duplicate build keys
+    d, s = _both_engines(int_col(lk), int_col(rk), how)
+    _assert_same(d, s)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+def test_dense_matches_sorted_null_keys(how):
+    lk = RNG.integers(0, 50, 600, dtype=np.int64)
+    rk = RNG.integers(0, 50, 200, dtype=np.int64)
+    lv = RNG.random(600) < 0.85
+    rv = RNG.random(200) < 0.85
+    d, s = _both_engines(int_col(lk, validity=lv), int_col(rk, validity=rv),
+                         how)
+    _assert_same(d, s)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+def test_dense_matches_sorted_unique_build(how):
+    # unique build keys take the scatter-built no-sort index and the
+    # no-expansion probe tail — the TPC-DS star shape
+    rk = RNG.permutation(np.arange(1000, 2000, dtype=np.int64))[:700]
+    lk = np.where(RNG.random(4000) < 0.8,
+                  rk[RNG.integers(0, 700, 4000)],
+                  RNG.integers(5000, 6000, 4000)).astype(np.int64)
+    d, s = _both_engines(int_col(lk), int_col(rk), how)
+    _assert_same(d, s)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+def test_dense_matches_sorted_empty_build(how):
+    lk = np.asarray([1, 2, 3], dtype=np.int64)
+    rk = np.zeros(0, dtype=np.int64)
+    d, s = _both_engines(int_col(lk), int_col(rk), how)
+    _assert_same(d, s)
+
+
+def test_dense_inner_join_vs_pandas():
+    nl, nr = 2000, 300
+    lk = RNG.integers(0, 120, nl, dtype=np.int64)
+    rk = RNG.integers(0, 120, nr, dtype=np.int64)
+    lv = np.arange(nl, dtype=np.int32)
+    rv = np.arange(nr, dtype=np.int32) + 7000
+    with join_plan.force_engine("dense"):
+        out = ops.inner_join(Table([int_col(lk), int_col(lv)]),
+                             Table([int_col(rk), int_col(rv)]), 0, 0)
+    got = sorted(zip(out[0].to_pylist(), out[1].to_pylist(),
+                     out[3].to_pylist()))
+    df = pd.merge(pd.DataFrame({"k": lk, "lv": lv}),
+                  pd.DataFrame({"k": rk, "rv": rv}), on="k")
+    assert got == sorted(zip(df["k"], df["lv"], df["rv"]))
+
+
+def test_planner_picks_dense_for_dense_keys_only():
+    dense = jnp.asarray(np.arange(100, 1100, dtype=np.int64))
+    sparse = jnp.asarray(
+        RNG.integers(0, 2**60, 1000, dtype=np.int64))
+    assert join_plan.build_index(dense, None, True).kind == "dense"
+    assert join_plan.build_index(sparse, None, True).kind == "sorted"
+    # ineligible dtypes never go dense, regardless of span
+    f = Column.from_numpy(np.asarray([1.0, 2.0]))
+    assert not join_plan.dense_eligible(f)
+    u = Column.from_numpy(np.asarray([1, 2], dtype=np.uint64))
+    assert not join_plan.dense_eligible(u)
+    i = Column.from_numpy(np.asarray([1, 2], dtype=np.int32))
+    assert join_plan.dense_eligible(i)
+
+
+def test_build_index_cache_hit_returns_identical_index():
+    data = jnp.asarray(np.arange(10, 500, dtype=np.int64))
+    ix1 = join_plan.build_index(data, None, True)
+    ix2 = join_plan.build_index(data, None, True)
+    assert ix1 is ix2                      # memoized on buffer identity
+    # a distinct buffer with equal contents is a different build side
+    data2 = jnp.asarray(np.arange(10, 500, dtype=np.int64))
+    assert join_plan.build_index(data2, None, True) is not ix1
+
+
+def test_cache_hit_join_indices_identical():
+    rt = int_col(RNG.permutation(np.arange(300, dtype=np.int64)))
+    lt = int_col(RNG.integers(0, 300, 2000, dtype=np.int64))
+    li1, ri1 = join_indices(lt, rt, "inner")
+    li2, ri2 = join_indices(lt, rt, "inner")   # build index from cache
+    np.testing.assert_array_equal(np.asarray(li1), np.asarray(li2))
+    np.testing.assert_array_equal(np.asarray(ri1), np.asarray(ri2))
+
+
+def test_forced_engine_env_var(monkeypatch):
+    monkeypatch.setenv("SRJT_JOIN_ENGINE", "sorted")
+    dense = jnp.asarray(np.arange(0, 256, dtype=np.int64))
+    assert join_plan.build_index(dense, None, True).kind == "sorted"
+    monkeypatch.setenv("SRJT_JOIN_ENGINE", "bogus")   # ignored
+    assert join_plan.forced_engine() is None
+
+
+# ---- join→aggregate fusion -------------------------------------------------
+
+
+def _fused_vs_unfused(lt, rt, left_on, right_on, keys, aggs):
+    fused = ops.join_aggregate(lt, rt, left_on, right_on, keys, aggs)
+    j = ops.inner_join(lt, rt, left_on, right_on)
+    ref = ops.groupby_aggregate(j, keys, aggs)
+    ks = list(range(len(keys)))
+    fused = ops.sort_table(fused, ks)
+    ref = ops.sort_table(ref, ks)
+    assert fused.num_rows == ref.num_rows
+    assert fused.num_columns == ref.num_columns
+    for i in range(ref.num_columns):
+        assert fused[i].to_pylist() == ref[i].to_pylist()
+
+
+def test_fused_unique_build_all_aggs():
+    # star shape: unique dimension PK, group by a dimension attribute
+    n, nd = 5000, 400
+    dim_sk = np.arange(10, 10 + nd, dtype=np.int64)
+    dim_cat = RNG.integers(0, 9, nd, dtype=np.int64)
+    fk = np.where(RNG.random(n) < 0.9, dim_sk[RNG.integers(0, nd, n)],
+                  RNG.integers(9000, 9500, n)).astype(np.int64)
+    val = RNG.integers(-50, 50, n, dtype=np.int64)
+    vv = RNG.random(n) < 0.9
+    lt = Table([int_col(fk), int_col(val, validity=vv)])
+    rt = Table([int_col(dim_sk), int_col(dim_cat)])
+    _fused_vs_unfused(lt, rt, 0, 0, [3],
+                      [(1, "sum"), (1, "count"), (1, "mean"),
+                       (1, "min"), (1, "max")])
+
+
+def test_fused_unique_build_left_side_keys():
+    n, nd = 3000, 256
+    dim_sk = np.arange(0, nd, dtype=np.int64)
+    fk = dim_sk[RNG.integers(0, nd, n)].astype(np.int64)
+    grp = RNG.integers(0, 6, n, dtype=np.int64)
+    val = RNG.integers(0, 100, n, dtype=np.int64)
+    lt = Table([int_col(fk), int_col(grp), int_col(val)])
+    rt = Table([int_col(dim_sk)])
+    _fused_vs_unfused(lt, rt, 0, 0, [1], [(2, "sum"), (2, "mean")])
+
+
+def test_fused_weighted_duplicate_build():
+    # duplicate build keys + probe-side-only keys/values → weighted path
+    n, nb = 2500, 300
+    base = np.arange(50, 150, dtype=np.int64)
+    bk = base[RNG.integers(0, 100, nb)].astype(np.int64)
+    fk = np.where(RNG.random(n) < 0.8, base[RNG.integers(0, 100, n)],
+                  RNG.integers(700, 900, n)).astype(np.int64)
+    grp = RNG.integers(0, 5, n, dtype=np.int64)
+    val = RNG.integers(-9, 9, n, dtype=np.int64)
+    vv = RNG.random(n) < 0.85
+    lt = Table([int_col(fk), int_col(grp), int_col(val, validity=vv)])
+    rt = Table([int_col(bk)])
+    _fused_vs_unfused(lt, rt, 0, 0, [1],
+                      [(2, "sum"), (2, "count"), (2, "mean"),
+                       (2, "min"), (2, "max")])
+
+
+def test_fused_fallback_right_side_keys_duplicate_build():
+    # duplicate build + RIGHT-side group key → materialized fallback
+    n, nb = 800, 120
+    base = np.arange(0, 40, dtype=np.int64)
+    bk = base[RNG.integers(0, 40, nb)].astype(np.int64)
+    bg = RNG.integers(0, 4, nb, dtype=np.int64)
+    fk = base[RNG.integers(0, 40, n)].astype(np.int64)
+    val = RNG.integers(0, 20, n, dtype=np.int64)
+    lt = Table([int_col(fk), int_col(val)])
+    rt = Table([int_col(bk), int_col(bg)])
+    _fused_vs_unfused(lt, rt, 0, 0, [3], [(1, "sum")])
+
+
+def test_fused_string_group_key_unique_build():
+    nd = 64
+    dim_sk = np.arange(0, nd, dtype=np.int64)
+    cats = Column.strings_from_list([f"cat{i % 7}" for i in range(nd)])
+    fk = dim_sk[RNG.integers(0, nd, 1500)].astype(np.int64)
+    val = RNG.integers(0, 30, 1500, dtype=np.int64)
+    lt = Table([int_col(fk), int_col(val)])
+    rt = Table([int_col(dim_sk), cats])
+    _fused_vs_unfused(lt, rt, 0, 0, [3], [(1, "sum"), (1, "count")])
+
+
+def test_fused_empty_probe():
+    lt = Table([int_col(np.zeros(0, np.int64)),
+                int_col(np.zeros(0, np.int64))])
+    rt = Table([int_col(np.arange(5, dtype=np.int64))])
+    out = ops.join_aggregate(lt, rt, 0, 0, [0], [(1, "sum")])
+    assert out.num_rows == 0
+
+
+def test_fused_under_capture_replay():
+    # the fused dense path must compile: planner scalars ride the tape and
+    # the build-index memo is disabled so capture and replay stay aligned
+    from spark_rapids_jni_tpu.models.compiled import compile_query
+
+    nd, n = 128, 2000
+    dim_sk = np.arange(0, nd, dtype=np.int64)
+    dim_cat = RNG.integers(0, 5, nd, dtype=np.int64)
+    fk = dim_sk[RNG.integers(0, nd, n)].astype(np.int64)
+    val = RNG.integers(0, 40, n, dtype=np.int64)
+    tables = {
+        "fact": Table([int_col(fk), int_col(val)]),
+        "dim": Table([int_col(dim_sk), int_col(dim_cat)]),
+    }
+
+    def q(t):
+        out = ops.join_aggregate(t["fact"], t["dim"], 0, 0, [3],
+                                 [(1, "sum")])
+        return ops.sort_table(out, [0])
+
+    eager = q(tables)
+    cq = compile_query(q, tables)
+    out = cq.run(tables)
+    assert out[0].to_pylist() == eager[0].to_pylist()
+    assert out[1].to_pylist() == eager[1].to_pylist()
+
+
+# ---- distributed dense shard probe ----------------------------------------
+
+
+def test_repartition_dense_spec_matches_sorted():
+    from spark_rapids_jni_tpu.parallel import make_mesh
+    from spark_rapids_jni_tpu.parallel.repartition_join import (
+        JoinAggSpec, repartition_join_agg)
+
+    mesh = make_mesh(8, "data")
+    rng = np.random.default_rng(7)
+    n_fact, n_item, n_cat = 2048, 256, 6
+    base = np.arange(100, 200, dtype=np.int64)
+    item_sk = base[rng.integers(0, 100, n_item)].astype(np.int64)
+    item_cat = rng.integers(0, n_cat, n_item).astype(np.int32)
+    fact_sk = np.where(rng.random(n_fact) < 0.8,
+                       base[rng.integers(0, 100, n_fact)],
+                       rng.integers(700, 900, n_fact)).astype(np.int64)
+    fact_qty = rng.integers(1, 30, n_fact).astype(np.int64)
+    fv = np.ones((n_fact, 2), bool)
+    iv = np.ones((n_item, 2), bool)
+    fv[:, 0] = rng.random(n_fact) < 0.9
+    iv[:, 0] = rng.random(n_item) < 0.9
+
+    common = dict(fact_schema=(sr.int64, sr.int64),
+                  build_schema=(sr.int64, sr.int32),
+                  fact_key_idx=0, build_key_idx=0, build_group_idx=1,
+                  fact_value_idx=1, num_groups=n_cat,
+                  fact_capacity=n_fact, build_capacity=n_item)
+    args = ((jnp.asarray(fact_sk), jnp.asarray(fact_qty)), jnp.asarray(fv),
+            (jnp.asarray(item_sk), jnp.asarray(item_cat)), jnp.asarray(iv))
+    # dense window deliberately wider than the key range (offset base)
+    dense = JoinAggSpec(**common, key_min=64, key_span=1024)
+    sorted_ = JoinAggSpec(**common)
+    ds, dc, dd = repartition_join_agg(mesh, dense, *args)
+    ss_, sc, sd = repartition_join_agg(mesh, sorted_, *args)
+    assert int(np.asarray(dd)) == 0 and int(np.asarray(sd)) == 0
+    np.testing.assert_array_equal(np.asarray(ds), np.asarray(ss_))
+    np.testing.assert_array_equal(np.asarray(dc), np.asarray(sc))

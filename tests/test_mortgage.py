@@ -47,6 +47,7 @@ def _expected_features(dfs):
     return out.reset_index(drop=True)
 
 
+@pytest.mark.slow
 def test_etl_matches_pandas(files, dfs):
     out = mortgage.etl(files)
     exp = _expected_features(dfs)

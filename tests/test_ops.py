@@ -319,6 +319,26 @@ class TestGroupbyNullKeys:
         out = ops.groupby_aggregate(masked, [0], [(1, "count")])
         assert out.num_rows == 2   # {null, 1}
 
+    def test_multi_key_null_group_not_split_by_stale_payload(self):
+        # null keys must tie in the sort so the secondary key orders them;
+        # otherwise the raw payload under the mask splits the null group
+        # into one segment per (stale value, b) run
+        rng = np.random.default_rng(13)
+        n = 1500
+        a = rng.integers(0, 7, n).astype(np.int64)
+        b = rng.integers(0, 5, n).astype(np.int64)
+        v = rng.integers(-100, 100, n).astype(np.int64)
+        av = rng.random(n) < 0.9
+        t = Table([Column.from_numpy(a, validity=av),
+                   Column.from_numpy(b), Column.from_numpy(v)])
+        out = ops.groupby_aggregate(t, [0, 1], [(2, "sum")])
+        df = pd.DataFrame({"a": np.where(av, a, -1), "b": b, "v": v})
+        want = df.groupby(["a", "b"])["v"].sum()
+        got = sorted(zip([-1 if k is None else k
+                          for k in out[0].to_pylist()],
+                         out[1].to_pylist(), out[2].to_pylist()))
+        assert got == sorted((ka, kb, s) for (ka, kb), s in want.items())
+
     def test_var_numerically_stable(self):
         # mean >> spread: the naive sum-of-squares identity returns 0.0
         vals = np.asarray([1e8, 1e8 + 1, 1e8 + 2], np.float64)

@@ -27,6 +27,7 @@ def test_full_coverage():
     assert set(PQ.QUERIES) == set(tpcds.QUERIES)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("qname", sorted(tpcds.QUERIES))
 def test_same_cardinality(data, qname):
     dfs, tables = data

@@ -14,7 +14,8 @@ import pyarrow.parquet as pq
 import pytest
 
 from spark_rapids_jni_tpu import types as T
-from spark_rapids_jni_tpu.parquet.decode import (decode_delta_binary_packed,
+from spark_rapids_jni_tpu.parquet.decode import (NestedDecodeUnsupported,
+                                                 decode_delta_binary_packed,
                                                  read_table)
 
 
@@ -184,6 +185,22 @@ class TestListColumns:
         data = write(pa.table(
             {"l": pa.array(vals, pa.list_(pa.list_(pa.int32())))}))
         with pytest.raises(NotImplementedError):
+            read_table(data)
+
+    def test_list_of_list_rejected_early_with_path(self):
+        # the pre-decode schema walk names the offending column, so the
+        # failure surfaces before any chunk decode (pruner/decoder parity)
+        vals = [[[1]], [[2, 3]]]
+        data = write(pa.table(
+            {"deep": pa.array(vals, pa.list_(pa.list_(pa.int32())))}))
+        with pytest.raises(NestedDecodeUnsupported, match="deep"):
+            read_table(data)
+
+    def test_map_rejected_early_with_path(self):
+        data = write(pa.table(
+            {"m": pa.array([[("k", 1)], [("j", 2)]],
+                           pa.map_(pa.string(), pa.int64()))}))
+        with pytest.raises(NestedDecodeUnsupported, match="m.*MAP"):
             read_table(data)
 
     def test_mixed_flat_and_list_with_selection(self):

@@ -106,6 +106,7 @@ def test_tall_narrow():
     roundtrip_and_differential(Table([random_column(sr.int32, 4096)]))
 
 
+@pytest.mark.slow
 def test_wide_256_columns():
     t = Table([random_column(sr.int8, 13) for _ in range(256)])
     roundtrip_and_differential(t)
@@ -155,11 +156,13 @@ def test_multi_batch_splitting():
 
 # ---- strings --------------------------------------------------------------
 
+@pytest.mark.slow
 def test_simple_string():
     t = Table([random_column(sr.int32, 11), random_column(sr.string, 11)])
     roundtrip_and_differential(t)
 
 
+@pytest.mark.slow
 def test_two_string_columns():
     t = Table([random_column(sr.string, 29), random_column(sr.int64, 29),
                random_column(sr.string, 29)])
@@ -167,12 +170,14 @@ def test_two_string_columns():
 
 
 @pytest.mark.parametrize("pattern", ["most", "few"])
+@pytest.mark.slow
 def test_strings_with_nulls(pattern):
     t = Table([random_column(sr.string, 53, pattern),
                random_column(sr.int16, 53, pattern)])
     roundtrip_and_differential(t)
 
 
+@pytest.mark.slow
 def test_many_strings_mixed():
     n = 512
     cols = []
@@ -219,6 +224,7 @@ def test_fixed_batches_are_u32_words():
         np.testing.assert_array_equal(np.asarray(a.data), np.asarray(c.data))
 
 
+@pytest.mark.slow
 def test_xpack_geometry_not_reused_across_layouts():
     """Round-4 regression: the xpack geometry memo is keyed on the string
     column's offsets arrays — REUSING the same string Column under a
@@ -260,6 +266,7 @@ def _xpack_off():
     return ctx()
 
 
+@pytest.mark.slow
 def test_from_rows_xpack_differential():
     """The fused inverse engine must byte-match the non-xpack from_rows
     path (which matches the NumPy oracle) across geometries that stress
@@ -282,6 +289,7 @@ def test_from_rows_xpack_differential():
         assert_tables_equal(layout_got, want)
 
 
+@pytest.mark.slow
 def test_from_rows_xpack_engages():
     """Regression: the engine must actually run (not silently fall back)
     on the bench-shaped geometry."""
@@ -306,6 +314,7 @@ def test_from_rows_xpack_engages():
                                   np.asarray(t[1].offsets))
 
 
+@pytest.mark.slow
 def test_from_rows_xpack_corrupt_slot_raises():
     """Shuffle-received rows with an out-of-row slot must raise, not read
     out of bounds (host_table.cpp srjt_from_rows hardening parity)."""
@@ -328,6 +337,7 @@ def test_from_rows_xpack_corrupt_slot_raises():
         convert_from_rows(bad, t.schema)
 
 
+@pytest.mark.slow
 def test_xpack_fallback_accounting():
     """A geometry outside the packing caps must fall back AND say why."""
     from spark_rapids_jni_tpu.rowconv import xpack

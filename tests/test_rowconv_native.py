@@ -83,6 +83,7 @@ def test_fixed_unpack_roundtrip():
             np.asarray(got.validity_or_true()))
 
 
+@pytest.mark.slow
 def test_string_pack_matches_oracle_and_device():
     t = _string_table()
     cb, co = cpp.to_rows_np(t)
@@ -108,6 +109,7 @@ def test_string_unpack_roundtrip():
             np.asarray(got.validity_or_true()))
 
 
+@pytest.mark.slow
 def test_cross_engine_roundtrip_device_to_cpp():
     """Rows produced on device decode identically through the C++ engine."""
     t = _string_table(n=64, seed=9)

@@ -10,6 +10,11 @@ from spark_rapids_jni_tpu.parallel import (make_mesh, bucketize_rows,
                                            all_to_all_shuffle)
 from spark_rapids_jni_tpu.parallel.shuffle import received_mask
 
+try:                                    # jax ≥ 0.5 top-level name
+    _shard_map = jax.shard_map
+except AttributeError:                  # 0.4.x keeps it in experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def test_bucketize_groups_and_counts():
     rows = jnp.asarray(np.arange(20, dtype=np.uint8).reshape(10, 2))
@@ -53,9 +58,9 @@ def test_all_to_all_shuffle_delivers_every_row_once():
                 jax.lax.psum(recv.dropped, "data"),
                 jax.lax.psum(ok.astype(jnp.int32), "data"))
 
-    fn = jax.jit(jax.shard_map(step, mesh=mesh,
-                               in_specs=(P("data"), P("data")),
-                               out_specs=(P(), P(), P())))
+    fn = jax.jit(_shard_map(step, mesh=mesh,
+                            in_specs=(P("data"), P("data")),
+                            out_specs=(P(), P(), P())))
     # keys < 256 so the uint8 row payload round-trips the key exactly
     total, dropped, ok = fn(jnp.asarray(keys_np), jnp.asarray(rows_np))
     assert int(np.asarray(total)[0] if np.asarray(total).ndim else total) == n_dev * per_dev

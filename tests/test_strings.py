@@ -222,6 +222,7 @@ def test_concat():
     assert out.to_pylist() == ["x1", "2", None, None]
 
 
+@pytest.mark.slow
 def test_strings_roundtrip_through_rowconv():
     """String columns keyed ops compose with the JCUDF transcode."""
     vals = make_strings(40, seed=9, null_every=11)

@@ -196,6 +196,7 @@ def test_q_store_counts(tables, dfs):
     assert 0 in out[2].to_numpy().tolist()
 
 
+@pytest.mark.slow
 def test_run_all_smoke(files):
     # spec-default parameters may select nothing at this mini scale — an
     # empty result is a valid result (Spark returns empty, not an error)

@@ -52,6 +52,7 @@ def _rollup_expect(j, keys, val, gid_levels):
     return pd.concat(frames, ignore_index=True)
 
 
+@pytest.mark.slow
 def test_q36_rollup(tables, dfs):
     out = tpcds.q36_rollup(tables)
     ss, item = dfs["store_sales"], dfs["item"]
@@ -403,6 +404,7 @@ def test_q_null_share(tables, dfs):
                                rtol=1e-9)
 
 
+@pytest.mark.slow
 def test_run_all_includes_new_queries(files):
     results = tpcds.run_all(files)
     assert len(results) >= 41

@@ -17,6 +17,7 @@ def data():
     return tpch_data.generate(n=20_000, seed=9)
 
 
+@pytest.mark.slow
 def test_q1_matches_exact_oracle(data):
     file_bytes, raw = data
     out = tpch_q1.run(file_bytes, CUTOFF)

@@ -336,6 +336,100 @@ def check_f64bits():
            f"decoded={dbig.tolist()}")
 
 
+def check_query_ops():
+    """Minimal on-chip repros for the op family behind the 13 TPU-crashing
+    queries (VERDICT weak #1: rollup/grouping-sets/cube, rank/window,
+    string-compare) — each probe is one op over ~1-2k rows, differentially
+    checked against a host oracle, so a worker crash here pinpoints the
+    culprit op without running the query suite."""
+    from spark_rapids_jni_tpu import ops
+    from spark_rapids_jni_tpu.ops import strings as S
+    from spark_rapids_jni_tpu.ops import window as W
+
+    rng = np.random.default_rng(13)
+    n = 1500
+    a = rng.integers(0, 7, n).astype(np.int64)
+    b = rng.integers(0, 5, n).astype(np.int64)
+    v = rng.integers(-100, 100, n).astype(np.int64)
+    av = rng.random(n) < 0.9       # null keys ride along (Spark groups them)
+    t = Table([Column.from_numpy(a, validity=av), Column.from_numpy(b),
+               Column.from_numpy(v)])
+
+    def host_sets(sets):
+        # oracle: one dict pass per grouping set, Spark grouping_id bits
+        # (MSB = first key, set when the key is aggregated away)
+        rows = set()
+        for s in sets:
+            gid = sum(1 << (1 - k) for k in range(2) if k not in s)
+            acc = {}
+            for i in range(n):
+                ka = (int(a[i]) if av[i] else None) if 0 in s else None
+                kb = int(b[i]) if 1 in s else None
+                acc[(ka, kb)] = acc.get((ka, kb), 0) + int(v[i])
+            rows |= {(ka, kb, sv, gid) for (ka, kb), sv in acc.items()}
+        return rows
+
+    def got_rows(out):
+        return set(zip(out[0].to_pylist(), out[1].to_pylist(),
+                       out[2].to_pylist(), out[3].to_pylist()))
+
+    out = ops.groupby_rollup(t, [0, 1], [(2, "sum")])
+    record("query-ops rollup(sum)",
+           got_rows(out) == host_sets([[0, 1], [0], []]))
+    out = ops.groupby_cube(t, [0, 1], [(2, "sum")])
+    record("query-ops cube(sum)",
+           got_rows(out) == host_sets([[0, 1], [0], [1], []]))
+    out = ops.groupby_grouping_sets(t, [0, 1], [[0], [1]], [(2, "sum")])
+    record("query-ops grouping-sets(sum)",
+           got_rows(out) == host_sets([[0], [1]]))
+
+    # rank / dense_rank / row_number / lag vs a host scan
+    part = rng.integers(0, 40, n).astype(np.int64)
+    key = rng.integers(0, 25, n).astype(np.int64)
+    wt = Table([Column.from_numpy(part), Column.from_numpy(key),
+                Column.from_numpy(v)])
+    spec = W.WindowSpec(wt, partition_by=[0], order_by_keys=[1])
+    order = sorted(range(n), key=lambda i: (part[i], key[i], i))
+    exp_rn = np.zeros(n, np.int64)
+    exp_rk = np.zeros(n, np.int64)
+    exp_dr = np.zeros(n, np.int64)
+    exp_lag = [None] * n
+    pos = rk = dr = 0
+    for j, i in enumerate(order):
+        prev = order[j - 1] if j else None
+        if prev is None or part[prev] != part[i]:
+            pos, rk, dr = 1, 1, 1
+        else:
+            pos += 1
+            if key[prev] != key[i]:
+                rk, dr = pos, dr + 1
+            exp_lag[i] = int(v[prev])
+        exp_rn[i], exp_rk[i], exp_dr[i] = pos, rk, dr
+    record("query-ops row_number",
+           np.array_equal(np.asarray(W.row_number(spec).to_numpy()), exp_rn))
+    record("query-ops rank",
+           np.array_equal(np.asarray(W.rank(spec, [1]).to_numpy()), exp_rk))
+    record("query-ops dense_rank",
+           np.array_equal(np.asarray(W.dense_rank(spec, [1]).to_numpy()),
+                          exp_dr))
+    record("query-ops lag", W.lag(spec, 2, 1).to_pylist() == exp_lag)
+
+    # string compares (contains / starts_with / equal_to_scalar)
+    words = ["", "brand#1", "BRAND#12", "spark", "s", "importers #1",
+             "xx#1yy", None]
+    strs = [words[i] for i in rng.integers(0, len(words), n)]
+    sc = Column.strings_from_list(strs)
+    want = [None if s is None else ("#1" in s) for s in strs]
+    record("query-ops strings.contains",
+           S.contains(sc, "#1").to_pylist() == want)
+    want = [None if s is None else s.startswith("s") for s in strs]
+    record("query-ops strings.starts_with",
+           S.starts_with(sc, "s").to_pylist() == want)
+    want = [None if s is None else (s == "spark") for s in strs]
+    record("query-ops strings.equal_to_scalar",
+           S.equal_to_scalar(sc, "spark").to_pylist() == want)
+
+
 def main():
     t0 = time.time()
     RESULTS["backend"] = jax.default_backend()
@@ -357,6 +451,9 @@ def main():
         check_fixed_words()
         print("f64 bits<->values:", flush=True)
         check_f64bits()
+        print("chip-killer query ops (rollup/window/string-compare):",
+              flush=True)
+        check_query_ops()
     RESULTS["seconds"] = round(time.time() - t0, 1)
     out = sys.argv[1] if len(sys.argv) > 1 else "PALLAS_TPU_CHECK.json"
     with open(out, "w") as f:
