@@ -1,0 +1,89 @@
+#!/usr/bin/env bash
+# Metrics/trace subsystem smoke — the observability analog of the java
+# RowConversionSmoke step: run ONE compiled TPC-DS query end to end with
+# metrics + JSON structured logging enabled, export the Chrome trace, and
+# assert the trace is well-formed (span tree rooted at the query, nonzero
+# join-engine counters, trace_report.py digests it).  The artifacts land in
+# target/metrics_smoke/ for workflow upload.
+#
+# Usage: ci/metrics_smoke.sh [n_sales] [query]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N_SALES="${1:-200000}"
+QUERY="${2:-q3}"
+OUT=target/metrics_smoke
+mkdir -p "$OUT"
+
+echo "== metrics smoke: $QUERY over $N_SALES rows =="
+XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+SPARK_RAPIDS_TPU_METRICS=1 \
+SPARK_RAPIDS_TPU_LOG=json \
+SPARK_RAPIDS_TPU_LOG_FILE="$OUT/events.jsonl" \
+SRJT_SMOKE_OUT="$OUT" SRJT_SMOKE_N="$N_SALES" SRJT_SMOKE_Q="$QUERY" \
+python - <<'PYEOF'
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+out = os.environ["SRJT_SMOKE_OUT"]
+n_sales = int(os.environ["SRJT_SMOKE_N"])
+qname = os.environ["SRJT_SMOKE_Q"]
+
+from benchmarks import tpcds_data
+from spark_rapids_jni_tpu.models import tpcds
+from spark_rapids_jni_tpu.models.compiled import compile_query
+from spark_rapids_jni_tpu.utils import metrics
+
+files = tpcds_data.generate(n_sales=n_sales, n_items=2_000, n_stores=10,
+                            seed=5)
+tables = tpcds.load_tables(files)
+
+metrics.reset()
+with metrics.query_span(qname, n_sales=n_sales):
+    cq = compile_query(tpcds.QUERIES[qname], tables)
+res = cq.run(tables)
+print(f"{qname}: {res.num_rows} rows, tape_len={len(cq.tape)}")
+
+trace_path = metrics.export_chrome_trace(os.path.join(out, "trace.json"))
+with open(os.path.join(out, "summary.json"), "w") as f:
+    json.dump(metrics.summary(), f, indent=1)
+
+# --- assertions: the acceptance-criterion shape -----------------------------
+with open(trace_path) as f:
+    doc = json.load(f)
+events = doc["traceEvents"]
+xs = [e for e in events if e.get("ph") == "X"]
+assert xs, "no span events in trace"
+names = {e["name"] for e in xs}
+assert f"query:{qname}" in names, f"missing query root span: {sorted(names)}"
+assert any(n.startswith("join.") for n in names), names
+assert any(n.startswith("groupby.") or n.startswith("sort.")
+           for n in names), names
+counters = doc["srjtCounters"]
+assert sum(v for k, v in counters.items()
+           if k.startswith("join.engine.")) > 0, counters
+assert sum(v for k, v in counters.items()
+           if k.startswith("join.build_index.")) > 0, counters
+assert counters.get("compiled.capture", 0) >= 1, counters
+
+roots = metrics.span_roots()
+root = next(s for s in roots if s["name"] == f"query:{qname}")
+assert root.get("children"), "query root span has no stage children"
+
+log_path = os.path.join(out, "events.jsonl")
+assert os.path.exists(log_path), "structured log missing"
+with open(log_path) as f:
+    for line in f:
+        rec = json.loads(line)          # every line is well-formed JSON
+        assert "event" in rec and "ts" in rec
+print("trace + structured log well-formed:", trace_path)
+PYEOF
+
+echo "== trace_report =="
+python tools/trace_report.py "$OUT/trace.json" 15
+
+echo "metrics smoke OK"

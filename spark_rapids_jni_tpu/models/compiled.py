@@ -44,7 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils import syncs
+from ..utils import metrics, syncs
 
 
 class StaleTapeError(ValueError):
@@ -73,14 +73,20 @@ class CompiledQuery:
     """
 
     def __init__(self, qfn: Callable, tables: Any):
+        qname = self.name = getattr(qfn, "__name__", "query")
         tape: list[int] = []
-        with syncs.capture(tape):
-            # eager capture run (and oracle)
-            self.expected = _materialized(qfn(tables))
+        metrics.count("compiled.capture")
+        with metrics.span(f"compiled.capture:{qname}"):
+            with syncs.capture(tape):
+                # eager capture run (and oracle)
+                self.expected = _materialized(qfn(tables))
         self.tape = tuple(tape)
-        qname = getattr(qfn, "__name__", "query")
+        metrics.observe("compiled.tape_len", len(self.tape))
 
         def _traced(tbls):
+            # counted at trace time on purpose: each execution of this
+            # body IS one (re)trace → XLA recompile of the query program
+            metrics.count("compiled.recompile", in_trace=True)
             with syncs.replay(list(self.tape)):
                 return _materialized(qfn(tbls))
         _traced.__name__ = f"compiled_{qname}"
@@ -103,22 +109,35 @@ class CompiledQuery:
         """Checked execution: one stacked sync validates the tape, then
         one dispatch runs the plan.  Raises :class:`StaleTapeError` when
         the data's resolved sizes differ from the capture run's."""
-        if self.tape:
-            syncs.note_sync()        # the guard's one stacked D2H pull
-            actual = np.asarray(self._sizes_prog(tables))
-            if tuple(int(v) for v in actual) != self.tape:
-                diffs = [i for i, (a, b) in enumerate(zip(actual, self.tape))
-                         if int(a) != b]
-                raise StaleTapeError(
-                    f"compiled plan is stale: resolved sizes differ from "
-                    f"the capture run at tape positions {diffs[:8]} "
-                    f"(of {len(self.tape)}) — re-run compile_query on the "
-                    "refreshed tables")
-        return self._prog(tables)
+        with metrics.span(f"compiled.run:{self.name}", tape_len=len(self.tape)):
+            if self.tape:
+                with metrics.span("compiled.tape_check"):
+                    syncs.note_sync()    # the guard's one stacked D2H pull
+                    actual = np.asarray(self._sizes_prog(tables))
+                if tuple(int(v) for v in actual) != self.tape:
+                    diffs = [i for i, (a, b) in
+                             enumerate(zip(actual, self.tape)) if int(a) != b]
+                    metrics.count("compiled.tape_mismatch")
+                    raise StaleTapeError(
+                        f"compiled plan is stale: resolved sizes differ from "
+                        f"the capture run at tape positions {diffs[:8]} "
+                        f"(of {len(self.tape)}) — re-run compile_query on "
+                        "the refreshed tables")
+            metrics.count("compiled.replay_run")
+            with metrics.span("compiled.dispatch"):
+                return self._prog(tables)
 
     def run_unchecked(self, tables):
-        """Steady-loop execution: no staleness check, one dispatch."""
-        return self._prog(tables)
+        """Steady-loop execution: no staleness check, one dispatch.
+
+        The disabled-metrics path is ONE bool check away from the raw
+        dispatch — this is the steady loop the <1% overhead guarantee
+        covers."""
+        if not metrics.enabled():
+            return self._prog(tables)
+        metrics.count("compiled.replay_run")
+        with metrics.span(f"compiled.run_unchecked:{self.name}"):
+            return self._prog(tables)
 
     def lower_text(self, tables) -> str:
         """StableHLO of the whole-query program (diagnostics)."""

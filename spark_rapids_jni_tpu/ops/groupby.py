@@ -24,6 +24,7 @@ import numpy as np
 
 from .. import types as T
 from ..column import Column, Table
+from ..utils import metrics
 from .filter import gather
 from .sort import order_by
 
@@ -145,6 +146,13 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
     Returns a table of [key columns..., agg results...], one row per distinct
     key tuple (sorted by key — a stable, deterministic output order).
     """
+    with metrics.span("groupby.aggregate", keys=len(key_indices),
+                      aggs=len(aggs), rows=table.num_rows):
+        return _groupby_aggregate(table, key_indices, aggs)
+
+
+def _groupby_aggregate(table: Table, key_indices: Sequence[int],
+                       aggs: Sequence[tuple[int, str]]) -> Table:
     n = table.num_rows
     if n == 0:
         if not key_indices:
@@ -196,6 +204,9 @@ def groupby_aggregate(table: Table, key_indices: Sequence[int],
     seg_ids = _segment_ids(skeys, svalid)
     from ..utils import syncs
     num_segments = syncs.scalar(seg_ids[-1]) + 1   # scalar sync (group count)
+    if metrics.recording():
+        metrics.observe("groupby.groups", num_segments)
+        metrics.annotate(groups=num_segments)
     return _aggregate_sorted(sorted_tbl, list(key_indices), str_dicts,
                              seg_ids, num_segments, aggs, n)
 

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..column import Column, Table
-from ..utils import syncs
+from ..utils import metrics, syncs
 from .filter import gather
 
 
@@ -57,6 +57,11 @@ def join_indices(left: Column, right: Column,
     ``semi``/``anti`` return only left_idx.  ``left`` outer marks unmatched
     rows with right_idx == -1 (callers null-fill on gather).
     """
+    with metrics.span("join.indices", how=how):
+        return _join_indices(left, right, how)
+
+
+def _join_indices(left: Column, right: Column, how: str):
     if left.dtype.is_variable_width or right.dtype.is_variable_width:
         # string keys: one shared dictionary makes code equality == string
         # equality across both sides (ops.strings)
@@ -87,6 +92,8 @@ def join_indices(left: Column, right: Column,
         pos = jnp.minimum(lo, nr - 1)
         if how == "inner":
             total = syncs.scalar(jnp.sum(counts))   # scalar sync (pair count)
+            if metrics.recording():
+                metrics.observe("join.match_rows", total)
             left_idx = jnp.nonzero(counts > 0, size=total)[0]
             right_idx = ix.row_ids[pos[left_idx]]
             return left_idx, right_idx
@@ -100,6 +107,12 @@ def join_indices(left: Column, right: Column,
         out_counts = counts
 
     total = syncs.scalar(jnp.sum(out_counts))     # scalar sync (pair count)
+    if metrics.recording():
+        # the ephemeral pair-expansion buffer (~10× input on skewed keys)
+        # is the HBM-arena pressure point — ROADMAP open item
+        metrics.count("join.expand.calls")
+        metrics.observe("join.expand.pair_elements", total)
+        metrics.annotate(expand_pairs=total)
     starts = jnp.cumsum(out_counts) - out_counts
     pair_ids = jnp.arange(total, dtype=jnp.int64)
     # row of each output pair: inverse of starts (searchsorted right)

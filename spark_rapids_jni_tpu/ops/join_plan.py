@@ -52,7 +52,7 @@ import numpy as np
 
 from .. import types as T
 from ..column import Column, Table, force_column
-from ..utils import syncs
+from ..utils import metrics, syncs
 
 DENSE_SPAN_FACTOR = 2
 DENSE_SPAN_FLOOR = 4096
@@ -111,9 +111,18 @@ def build_index(data: jnp.ndarray, valid, dense_ok: bool) -> BuildIndex:
     key_arrays = (data,) if valid is None else (data, valid)
     hit = syncs.memo_get(tag, key_arrays)
     if hit is not None:
+        if metrics.recording():
+            metrics.count("join.build_index.cache_hit")
+            metrics.count(f"join.engine.{hit.kind}")
         return hit
-    ix = _build_index(data, valid, dense_ok and forced != "sorted",
-                      forced == "dense")
+    with metrics.span("join.build_index"):
+        ix = _build_index(data, valid, dense_ok and forced != "sorted",
+                          forced == "dense")
+        if metrics.recording():
+            metrics.count("join.build_index.cache_miss")
+            metrics.count(f"join.engine.{ix.kind}")
+            metrics.annotate(engine=ix.kind, n_valid=ix.n_valid,
+                             key_span=ix.span)
     syncs.memo_put(tag, key_arrays, ix)
     return ix
 
@@ -232,32 +241,38 @@ def join_aggregate(left: Table, right: Table, left_on: int, right_on: int,
 
     needed = list(group_keys) + [vi for vi, _ in aggs]
     if ix.unique:
-        lo, counts = probe_counts(ix, ldata, lvalid)
-        m = counts > 0
-        k = syncs.scalar(jnp.sum(m))
-        li = jnp.nonzero(m, size=k)[0]
-        ri = ix.row_ids[jnp.minimum(lo[li], max(ix.n_valid - 1, 0))]
-        cols = [_take_col(left[ci], li) if ci < nl
-                else _take_col(right[ci - nl], ri) for ci in needed]
-        nk = len(group_keys)
-        return groupby_aggregate(
-            Table(cols), list(range(nk)),
-            [(nk + i, agg) for i, (_, agg) in enumerate(aggs)])
+        metrics.count("join.fused.unique_gather")
+        with metrics.span("join.aggregate", path="unique_gather"):
+            lo, counts = probe_counts(ix, ldata, lvalid)
+            m = counts > 0
+            k = syncs.scalar(jnp.sum(m))
+            li = jnp.nonzero(m, size=k)[0]
+            ri = ix.row_ids[jnp.minimum(lo[li], max(ix.n_valid - 1, 0))]
+            cols = [_take_col(left[ci], li) if ci < nl
+                    else _take_col(right[ci - nl], ri) for ci in needed]
+            nk = len(group_keys)
+            return groupby_aggregate(
+                Table(cols), list(range(nk)),
+                [(nk + i, agg) for i, (_, agg) in enumerate(aggs)])
 
     if (group_keys and all(ci < nl for ci in needed)
             and _weighted_ok([left[ci] for ci in group_keys],
                              [(left[vi], agg) for vi, agg in aggs])):
-        lo, counts = probe_counts(ix, ldata, lvalid)
-        m = counts > 0
-        k = syncs.scalar(jnp.sum(m))
-        li = jnp.nonzero(m, size=k)[0]
-        w = counts.astype(jnp.int64)[li]
-        return _weighted_groupby(
-            [_take_col(left[ci], li) for ci in group_keys],
-            [(_take_col(left[vi], li), agg) for vi, agg in aggs], w)
+        metrics.count("join.fused.weighted_groupby")
+        with metrics.span("join.aggregate", path="weighted_groupby"):
+            lo, counts = probe_counts(ix, ldata, lvalid)
+            m = counts > 0
+            k = syncs.scalar(jnp.sum(m))
+            li = jnp.nonzero(m, size=k)[0]
+            w = counts.astype(jnp.int64)[li]
+            return _weighted_groupby(
+                [_take_col(left[ci], li) for ci in group_keys],
+                [(_take_col(left[vi], li), agg) for vi, agg in aggs], w)
 
-    j = inner_join(left, right, left_on, right_on)
-    return groupby_aggregate(j, list(group_keys), list(aggs))
+    metrics.count("join.fused.fallback_join")
+    with metrics.span("join.aggregate", path="fallback_join"):
+        j = inner_join(left, right, left_on, right_on)
+        return groupby_aggregate(j, list(group_keys), list(aggs))
 
 
 def _weighted_ok(key_cols, val_aggs) -> bool:

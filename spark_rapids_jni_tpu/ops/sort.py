@@ -12,6 +12,7 @@ from typing import Sequence
 import jax.numpy as jnp
 
 from ..column import Table
+from ..utils import metrics
 from .filter import gather
 
 
@@ -19,6 +20,14 @@ def order_by(table: Table, keys: Sequence[int],
              ascending: Sequence[bool] | None = None,
              nulls_first: Sequence[bool] | None = None) -> jnp.ndarray:
     """Row ordering by the given key column indices (first key is primary)."""
+    with metrics.span("sort.order_by", keys=len(keys),
+                      rows=table.num_rows):
+        return _order_by(table, keys, ascending, nulls_first)
+
+
+def _order_by(table: Table, keys: Sequence[int],
+              ascending: Sequence[bool] | None = None,
+              nulls_first: Sequence[bool] | None = None) -> jnp.ndarray:
     ascending = list(ascending) if ascending else [True] * len(keys)
     nulls_first = list(nulls_first) if nulls_first else [True] * len(keys)
 
@@ -90,4 +99,5 @@ def f64_sort_key_lanes(col, descending: bool = False) -> list[jnp.ndarray]:
 def sort_table(table: Table, keys: Sequence[int],
                ascending: Sequence[bool] | None = None,
                nulls_first: Sequence[bool] | None = None) -> Table:
-    return gather(table, order_by(table, keys, ascending, nulls_first))
+    with metrics.span("sort.table", keys=len(keys), rows=table.num_rows):
+        return gather(table, order_by(table, keys, ascending, nulls_first))

@@ -34,6 +34,7 @@ except AttributeError:                  # 0.4.x keeps it in experimental
 
 from ..column import Column
 from ..ops import strings as S
+from ..utils import metrics
 
 
 class Dimension(NamedTuple):
@@ -106,6 +107,15 @@ def distributed_star_agg(mesh: jax.sharding.Mesh, dim: Dimension,
     axis = tuple(axis_name) if isinstance(axis_name, (tuple, list)) \
         else axis_name
     fn = _compiled_star_agg(mesh, dim.num_groups, axis)
+    if metrics.recording():
+        # record around the SPMD dispatch: sharded fact bytes cross ICI,
+        # the partial-aggregate psum is one [num_groups] all-reduce
+        metrics.count("dist.star_agg.calls")
+        metrics.count("dist.star_agg.fact_bytes",
+                      int(fact_key.nbytes) + int(fact_value.nbytes))
+        with metrics.span("dist.star_agg", groups=dim.num_groups,
+                          devices=len(mesh.devices.flat)):
+            return fn(dim.keys, dim.group_codes, fact_key, fact_value)
     return fn(dim.keys, dim.group_codes, fact_key, fact_value)
 
 

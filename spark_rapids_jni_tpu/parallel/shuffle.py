@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import metrics
+
 
 class Buckets(NamedTuple):
     rows: jnp.ndarray      # [P, capacity, row_size]
@@ -37,6 +39,14 @@ def bucketize_rows(rows: jnp.ndarray, part_id: jnp.ndarray,
     row's rank within its partition, scatter with out-of-range drop.
     """
     n, row_size = rows.shape
+    if metrics.recording():
+        # static-shape accounting only: this body usually runs under
+        # shard_map/jit tracing, so counts here are once-per-trace (the
+        # per-execution story is record_shuffle_stats, called eagerly on
+        # the exchanged result)
+        metrics.count("shuffle.bucketize.calls")
+        metrics.count("shuffle.bucketize.payload_bytes",
+                      n * row_size * rows.dtype.itemsize)
     # out-of-range destinations (partitioner bugs) are routed to a sentinel
     # partition P and counted in `dropped` — without this, a negative id
     # would wrap via negative indexing into partition P-1
@@ -79,3 +89,29 @@ def received_mask(buckets: Buckets) -> jnp.ndarray:
     capacity = buckets.rows.shape[1]
     return (jnp.arange(capacity, dtype=jnp.int32)[None, :]
             < buckets.counts[:, None])
+
+
+def record_shuffle_stats(buckets: Buckets) -> dict:
+    """Eager post-exchange accounting (record around dispatch — call on a
+    CONCRETE :class:`Buckets`, never inside shard_map): bytes actually
+    moved, rows dropped, and partition skew (max/mean bucket fill — the
+    straggler predictor for the all-to-all).
+
+    Returns the stats dict and, when metrics are enabled, feeds the
+    ``shuffle.*`` counters/gauges."""
+    counts = np.asarray(buckets.counts).reshape(-1)
+    row_size = buckets.rows.shape[-1] * buckets.rows.dtype.itemsize
+    valid_rows = int(counts.sum())
+    mean = counts.mean() if counts.size else 0.0
+    skew = float(counts.max() / mean) if valid_rows and mean > 0 else 1.0
+    stats = {"rows": valid_rows,
+             "bytes_moved": valid_rows * row_size,
+             "dropped": int(np.asarray(buckets.dropped).reshape(-1).sum()),
+             "partition_skew": round(skew, 4)}
+    if metrics.recording():
+        metrics.count("shuffle.rows_moved", stats["rows"])
+        metrics.count("shuffle.bytes_moved", stats["bytes_moved"])
+        metrics.count("shuffle.rows_dropped", stats["dropped"])
+        metrics.gauge_max("shuffle.partition_skew.max", skew)
+        metrics.observe("shuffle.partition_skew", skew)
+    return stats

@@ -36,6 +36,7 @@ import numpy as np
 from .. import types as T
 from ..column import Column, Table
 from ..faultinj import fault_site
+from ..utils import metrics
 from .footer import FMD, RG, CC, SE, extract_footer_bytes
 from .thrift import CompactReader, Struct
 
@@ -420,6 +421,15 @@ def _decode_chunk(file_bytes: bytes, chunk: Struct, max_def: int,
     total = md.get(CMD.TOTAL_COMPRESSED_SIZE)
     stream = _PageStream(file_bytes[start:start + total], codec)
 
+    rec = metrics.recording()      # one check per chunk, not per page
+    if rec:
+        codec_name = {CODEC_UNCOMPRESSED: "uncompressed",
+                      CODEC_SNAPPY: "snappy",
+                      CODEC_GZIP: "gzip"}.get(codec, f"codec{codec}")
+        metrics.count("parquet.chunks")
+        metrics.count("parquet.bytes.compressed", total)
+        metrics.count(f"parquet.codec.{codec_name}.chunks")
+
     dictionary = None
     vals_parts, len_parts, def_parts, rep_parts = [], [], [], []
     decoded = 0
@@ -427,6 +437,10 @@ def _decode_chunk(file_bytes: bytes, chunk: Struct, max_def: int,
         header, raw = stream.next_page()
         ptype = header.get(PH.TYPE)
         usize = header.get(PH.UNCOMPRESSED_SIZE)
+        if rec and ptype in (PAGE_DATA, PAGE_DATA_V2, PAGE_DICTIONARY):
+            metrics.count("parquet.pages.dict" if ptype == PAGE_DICTIONARY
+                          else "parquet.pages.data")
+            metrics.count("parquet.bytes.uncompressed", usize or 0)
         if ptype == PAGE_DICTIONARY:
             dph = header.get(PH.DICT_PAGE)
             data = _decompress(raw, codec, usize)
@@ -518,6 +532,8 @@ def _decode_chunk(file_bytes: bytes, chunk: Struct, max_def: int,
             rep_parts.append(reps)
         decoded += n
 
+    if rec:
+        metrics.count("parquet.values_decoded", decoded)
     defs_all = np.concatenate(def_parts) if def_parts else None
     reps_all = np.concatenate(rep_parts) if rep_parts else None
     if phys in _VARLEN_PHYS:
@@ -796,22 +812,24 @@ def read_table(file_bytes: bytes,
     want = list(range(len(leaves))) if columns is None else [
         names.index(c) for c in columns]
 
-    groups = meta.get(FMD.ROW_GROUPS)
-    per_col_parts: dict[int, list] = {i: [] for i in want}
-    for rg in groups.values:
-        chunks = rg.get(RG.COLUMNS).values
+    with metrics.span("parquet.read_table", columns=len(want),
+                      file_bytes=len(file_bytes)):
+        groups = meta.get(FMD.ROW_GROUPS)
+        per_col_parts: dict[int, list] = {i: [] for i in want}
+        for rg in groups.values:
+            chunks = rg.get(RG.COLUMNS).values
+            for i in want:
+                leaf = leaves[i]
+                per_col_parts[i].append(
+                    _decode_chunk(file_bytes, chunks[i], leaf.max_def,
+                                  leaf.max_rep, leaf.type_len))
+
+        cols = []
         for i in want:
             leaf = leaves[i]
-            per_col_parts[i].append(
-                _decode_chunk(file_bytes, chunks[i], leaf.max_def,
-                              leaf.max_rep, leaf.type_len))
-
-    cols = []
-    for i in want:
-        leaf = leaves[i]
-        parts = per_col_parts[i]
-        if leaf.max_rep > 0:
-            cols.append(_assemble_list(leaf, parts))
-        else:
-            cols.append(_assemble_flat(leaf, parts))
-    return Table(cols)
+            parts = per_col_parts[i]
+            if leaf.max_rep > 0:
+                cols.append(_assemble_list(leaf, parts))
+            else:
+                cols.append(_assemble_flat(leaf, parts))
+        return Table(cols)

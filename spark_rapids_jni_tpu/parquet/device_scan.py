@@ -39,6 +39,7 @@ import numpy as np
 
 from .. import types as T
 from ..column import Column, Table
+from ..utils import metrics
 from ..utils.tracing import traced
 from . import decode as D
 from .footer import extract_footer_bytes
@@ -898,6 +899,12 @@ def scan_table(file_bytes: bytes,
         outs = _decode_file_jit(plan, flat)
         for (i, _, _, _, assemble), out in zip(deferred, outs):
             by_index[i] = assemble(out)
+    if metrics.recording():
+        # device/host split per scan — the fast-path coverage counter
+        metrics.count("parquet.device_cols", len(want) - len(fallback))
+        metrics.count("parquet.host_fallback_cols", len(fallback))
+        metrics.annotate(device_cols=len(want) - len(fallback),
+                         fallback_cols=len(fallback))
     if fallback:
         host = D.read_table(file_bytes, columns=[names[i] for i in fallback])
         for j, i in enumerate(fallback):

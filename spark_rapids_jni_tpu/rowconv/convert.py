@@ -43,7 +43,7 @@ import numpy as np
 from .. import types as T
 from ..column import Column, Table
 from ..faultinj import fault_site
-from ..utils import bitmask
+from ..utils import bitmask, metrics
 from ..utils.tracing import traced
 from .layout import (RowLayout, compute_row_layout, build_batches,
                      row_sizes_with_strings, MAX_ROW_SIZE, MAX_BATCH_BYTES,
@@ -928,6 +928,7 @@ def convert_to_rows(table: Table,
                 tuple(c.data for c in cols),
                 tuple(c.validity for c in cols if c.validity is not None))
             out.append(RowBatch(data, offsets))
+        _record_transcode("rowconv.to_rows", n, out)
         return out
 
     # variable-width (strings) path: row sizes are data-dependent, so the
@@ -977,7 +978,18 @@ def convert_to_rows(table: Table,
         boffs = jnp.asarray(boffs_np)
         hostcache.seed(boffs, np.asarray(boffs_np, dtype=np.int64))
         out.append(RowBatch(data, boffs))
+    _record_transcode("rowconv.to_rows", n, out)
     return out
+
+
+def _record_transcode(prefix: str, rows: int, batches) -> None:
+    """rows/bytes transcoded counters (shared by both directions)."""
+    if metrics.recording():
+        nbytes = sum(b.num_bytes for b in batches)
+        metrics.count(f"{prefix}.rows", rows)
+        metrics.count(f"{prefix}.bytes", nbytes)
+        metrics.count(f"{prefix}.batches", len(batches))
+        metrics.annotate(rows=rows, row_bytes=nbytes)
 
 
 def _slice_column(col: Column, lo: int, hi: int) -> Column:
@@ -1005,6 +1017,7 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
     schema = list(schema)
     layout = compute_row_layout(schema)
     n = batch.num_rows
+    _record_transcode("rowconv.from_rows", n, [batch])
 
     if layout.fixed_width_only:
         if batch.num_bytes != n * layout.fixed_row_size:

@@ -1,0 +1,359 @@
+"""Process-wide metrics registry + hierarchical span recorder.
+
+The reference instruments every public entry with NVTX ranges and threads
+an RMM logging level through the build (SURVEY §5.5); this module is the
+query-level half of that story the TPU rebuild was missing: counters
+(join-engine choice, build-index cache hits, tape lengths, pages decoded,
+bytes shuffled), gauges (HBM live-byte watermarks), histograms (expansion
+pair totals), and a per-query SPAN TREE that upgrades the flat
+``tracing.func_range`` wall-time events into a parent/child stage
+hierarchy exportable as Chrome-trace JSON (``chrome://tracing`` /
+Perfetto-loadable) and as a structured summary dict.
+
+Knobs
+-----
+  SPARK_RAPIDS_TPU_METRICS=0|1        (default off)
+  SPARK_RAPIDS_TPU_METRICS_TRACE=<p>  default export path for
+                                      :func:`export_chrome_trace`
+
+Discipline
+----------
+* **Zero overhead when disabled.**  Every public entry is gated on ONE
+  module-level bool; :func:`span` returns a shared ``nullcontext`` without
+  allocating, counters return before touching any dict.
+* **Record around dispatch, never inside compiled bodies.**  All recording
+  is Python-side (eager orchestration, capture runs, dispatch wrappers).
+  Sites that re-trace under ``jax.jit`` replay (``utils.syncs`` replay
+  mode) are skipped automatically — a replay trace would otherwise
+  double-count the capture run's events and measure trace time instead of
+  run time.  The one deliberate exception is
+  ``count(..., in_trace=True)`` (e.g. ``compiled.recompile``), which
+  records trace-time occurrences on purpose.
+* No device syncs: values passed in must already be host ints/floats
+  (the op library's sizes all flow through ``syncs.scalar`` anyway).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Optional
+
+_enabled: bool = os.environ.get(
+    "SPARK_RAPIDS_TPU_METRICS", "0").lower() not in ("0", "off", "false", "")
+
+_lock = threading.Lock()
+_counters: dict[str, float] = {}
+_gauges: dict[str, float] = {}
+_hists: dict[str, dict] = {}        # name -> {count,total,min,max,buckets}
+
+_EPOCH = time.perf_counter()        # trace time base (ts exported rel. us)
+
+_tls = threading.local()            # per-thread open-span stack
+_roots: list["Span"] = []           # completed root spans (all threads)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: Optional[bool] = None) -> None:
+    """Toggle metrics at runtime; ``None`` re-reads the env knob."""
+    global _enabled
+    if on is None:
+        _enabled = os.environ.get(
+            "SPARK_RAPIDS_TPU_METRICS",
+            "0").lower() not in ("0", "off", "false", "")
+    else:
+        _enabled = bool(on)
+
+
+def recording() -> bool:
+    """True when events should be recorded NOW: metrics on, and not inside
+    a ``syncs.replay`` re-trace (which re-runs the already-recorded plan
+    Python under ``jax.jit``)."""
+    if not _enabled:
+        return False
+    from . import syncs
+    return syncs.mode() != "replay"
+
+
+def reset() -> None:
+    """Drop all counters, gauges, histograms, and completed spans."""
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _hists.clear()
+        _roots.clear()
+
+
+# --- counters / gauges / histograms ----------------------------------------
+
+
+def count(name: str, value: float = 1, *, in_trace: bool = False) -> None:
+    """Add ``value`` to counter ``name`` (no-op when disabled or replaying;
+    ``in_trace=True`` records even under a replay trace — for events whose
+    occurrence IS the trace, e.g. recompiles)."""
+    if not _enabled:
+        return
+    if not in_trace and not recording():
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + value
+
+
+def gauge(name: str, value: float) -> None:
+    """Set gauge ``name`` to ``value``."""
+    if not recording():
+        return
+    with _lock:
+        _gauges[name] = value
+
+
+def gauge_max(name: str, value: float) -> None:
+    """High-water gauge: keep the max of all samples (HBM watermarks)."""
+    if not recording():
+        return
+    with _lock:
+        if value > _gauges.get(name, float("-inf")):
+            _gauges[name] = value
+
+
+def observe(name: str, value: float) -> None:
+    """Record one histogram observation (count/total/min/max + log2
+    buckets — enough for skew questions without storing samples)."""
+    if not recording():
+        return
+    with _lock:
+        h = _hists.get(name)
+        if h is None:
+            h = _hists[name] = {"count": 0, "total": 0, "min": value,
+                                "max": value, "buckets": {}}
+        h["count"] += 1
+        h["total"] += value
+        h["min"] = min(h["min"], value)
+        h["max"] = max(h["max"], value)
+        b = f"<=2^{max(int(value), 0).bit_length()}"
+        h["buckets"][b] = h["buckets"].get(b, 0) + 1
+
+
+# --- span recorder ----------------------------------------------------------
+
+
+class Span:
+    """One timed range; completed children hang off ``children``."""
+
+    __slots__ = ("name", "attrs", "t0", "dur", "tid", "children")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self.t0 = 0.0           # seconds since _EPOCH, set on __enter__
+        self.dur = 0.0          # seconds
+        self.tid = 0
+        self.children: list[Span] = []
+
+    def annotate(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "Span":
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self)
+        self.tid = threading.get_ident()
+        self.t0 = time.perf_counter() - _EPOCH
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.dur = (time.perf_counter() - _EPOCH) - self.t0
+        stack = _tls.stack
+        stack.pop()
+        if stack:
+            stack[-1].children.append(self)
+        else:
+            with _lock:
+                _roots.append(self)
+
+    def as_dict(self) -> dict:
+        d = {"name": self.name, "start_ms": round(self.t0 * 1e3, 3),
+             "dur_ms": round(self.dur * 1e3, 3)}
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        if self.children:
+            d["children"] = [c.as_dict() for c in self.children]
+        return d
+
+
+_NOOP = contextlib.nullcontext()
+
+
+def span(name: str, **attrs):
+    """Context manager recording a span under the current thread's open
+    span (or as a new root).  Returns a shared no-op context when disabled
+    or under a replay trace — zero allocation on the hot path."""
+    if not recording():
+        return _NOOP
+    return Span(name, attrs)
+
+
+def current_span() -> Optional[Span]:
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+def annotate(**attrs) -> None:
+    """Attach attributes to the innermost open span (no-op without one)."""
+    if not recording():
+        return
+    sp = current_span()
+    if sp is not None:
+        sp.attrs.update(attrs)
+
+
+@contextlib.contextmanager
+def query_span(name: str, **attrs):
+    """Root span for one query execution, with HBM watermark samples
+    taken before and after (around dispatch — never inside it)."""
+    if not recording():
+        yield None
+        return
+    pre = sample_hbm("pre")
+    with span(f"query:{name}", **attrs) as sp:
+        yield sp
+    post = sample_hbm("post")
+    if pre is not None and post is not None:
+        sp.annotate(hbm_pre_bytes=pre, hbm_post_bytes=post)
+
+
+# --- HBM accounting ---------------------------------------------------------
+
+
+def sample_hbm(tag: str = "sample") -> Optional[int]:
+    """Sample live device memory: sum of ``jax.live_arrays()`` byte sizes
+    plus per-device allocator stats where the backend exposes them.
+    Updates ``hbm.live_bytes`` and the ``hbm.live_bytes.peak`` high-water
+    gauge; returns the live-byte total (None when disabled)."""
+    if not recording():
+        return None
+    import jax
+    try:
+        live = sum(int(getattr(a, "nbytes", 0) or 0)
+                   for a in jax.live_arrays())
+    except Exception:
+        live = 0
+    gauge("hbm.live_bytes", live)
+    gauge_max("hbm.live_bytes.peak", live)
+    try:
+        for i, d in enumerate(jax.local_devices()):
+            stats = getattr(d, "memory_stats", None)
+            stats = stats() if callable(stats) else None
+            if not stats:
+                continue
+            in_use = stats.get("bytes_in_use")
+            if in_use is not None:
+                gauge(f"hbm.device{i}.bytes_in_use", int(in_use))
+                gauge_max(f"hbm.device{i}.peak_bytes_in_use",
+                          int(stats.get("peak_bytes_in_use", in_use)))
+    except Exception:
+        pass                      # CPU/older backends: live_arrays only
+    return live
+
+
+# --- export -----------------------------------------------------------------
+
+
+def snapshot() -> dict:
+    """Counters/gauges/histograms as plain dicts (deep-copied)."""
+    with _lock:
+        return {"counters": dict(_counters), "gauges": dict(_gauges),
+                "histograms": {k: {**v, "buckets": dict(v["buckets"])}
+                               for k, v in _hists.items()}}
+
+
+def span_roots() -> list[dict]:
+    """Completed root span trees (dict form), in completion order."""
+    with _lock:
+        return [s.as_dict() for s in _roots]
+
+
+def _walk(spans, fn):
+    for s in spans:
+        fn(s)
+        _walk(s.children, fn)
+
+
+def stage_breakdown() -> dict[str, dict]:
+    """Aggregate all completed spans by name: call count, total/max ms —
+    the per-query stage table ``tools/query_bench.py`` emits."""
+    agg: dict[str, dict] = {}
+
+    def add(s: Span):
+        e = agg.setdefault(s.name, {"count": 0, "total_ms": 0.0,
+                                    "max_ms": 0.0})
+        e["count"] += 1
+        e["total_ms"] += s.dur * 1e3
+        e["max_ms"] = max(e["max_ms"], s.dur * 1e3)
+
+    with _lock:
+        _walk(list(_roots), add)
+    for e in agg.values():
+        e["total_ms"] = round(e["total_ms"], 3)
+        e["max_ms"] = round(e["max_ms"], 3)
+    return agg
+
+
+def summary() -> dict:
+    """One structured dict: counters, gauges, histograms, span aggregate."""
+    return {**snapshot(), "spans": stage_breakdown()}
+
+
+def chrome_trace() -> dict:
+    """The recorded spans + counters in Chrome-trace (JSON object) format.
+
+    Spans become complete ("ph": "X") events with microsecond ts/dur;
+    counters/gauges ride along both as trailing counter events and under
+    the ``srjtCounters``/``srjtGauges``/``srjtHistograms`` keys (the
+    object format ignores unknown top-level keys, so Perfetto and
+    ``chrome://tracing`` both load it and ``tools/trace_report.py`` gets
+    the registry without re-aggregating events)."""
+    pid = os.getpid()
+    events: list[dict] = []
+    end_us = 0.0
+
+    def emit(s: Span):
+        nonlocal end_us
+        ev = {"name": s.name, "cat": "srjt", "ph": "X", "pid": pid,
+              "tid": s.tid, "ts": round(s.t0 * 1e6, 3),
+              "dur": round(s.dur * 1e6, 3)}
+        if s.attrs:
+            ev["args"] = {k: v for k, v in s.attrs.items()}
+        events.append(ev)
+        end_us = max(end_us, (s.t0 + s.dur) * 1e6)
+
+    with _lock:
+        _walk(list(_roots), emit)
+        counters = dict(_counters)
+        gauges = dict(_gauges)
+        hists = {k: {**v, "buckets": dict(v["buckets"])}
+                 for k, v in _hists.items()}
+    for k, v in sorted(counters.items()):
+        events.append({"name": k, "cat": "srjt", "ph": "C", "pid": pid,
+                       "ts": round(end_us, 3), "args": {"value": v}})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "srjtCounters": counters, "srjtGauges": gauges,
+            "srjtHistograms": hists}
+
+
+def export_chrome_trace(path: Optional[str] = None) -> str:
+    """Write :func:`chrome_trace` as JSON; returns the path written.
+    Default path: ``SPARK_RAPIDS_TPU_METRICS_TRACE`` or
+    ``srjt_trace.json``."""
+    path = path or os.environ.get("SPARK_RAPIDS_TPU_METRICS_TRACE",
+                                  "srjt_trace.json")
+    with open(path, "w") as f:
+        json.dump(chrome_trace(), f)
+    return path

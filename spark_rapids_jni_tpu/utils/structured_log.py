@@ -29,17 +29,36 @@ _path: Optional[str] = os.environ.get("SPARK_RAPIDS_TPU_LOG_FILE")
 _stream = None
 
 
+def _close_stream_locked() -> None:
+    """Close + reset the lazily-opened stream.  Caller holds ``_lock`` —
+    every writer goes through :func:`event` (which holds the lock across
+    the ``_out()`` lookup AND the write), so no thread can be mid-write on
+    the stream being closed."""
+    global _stream
+    if _stream is not None:
+        try:
+            _stream.close()
+        except ValueError:        # already closed externally
+            pass
+        _stream = None
+
+
 def configure(mode: str | None = None, path: str | None = None) -> None:
-    """Override the env configuration at runtime ('off'|'text'|'json')."""
-    global _mode, _path, _stream
+    """Override the env configuration at runtime ('off'|'text'|'json').
+
+    Lock-consistent with :func:`event`: a path change or a flip to
+    ``off`` closes the open stream under the same lock writers hold, so
+    concurrent ``event()`` calls either finish on the old stream or open
+    the new one — never write to a closed file."""
+    global _mode, _path
     with _lock:
         if mode is not None:
             _mode = mode.lower()
+            if _mode == "off":
+                _close_stream_locked()
         if path is not None:
             _path = path
-            if _stream is not None:
-                _stream.close()
-            _stream = None
+            _close_stream_locked()
 
 
 def enabled() -> bool:
@@ -47,10 +66,12 @@ def enabled() -> bool:
 
 
 def _out():
+    """The output stream.  Caller must hold ``_lock``; reopens if a
+    ``configure`` closed the stream since the last write."""
     global _stream
     if _path is None:
         return sys.stderr
-    if _stream is None:
+    if _stream is None or _stream.closed:
         _stream = open(_path, "a", buffering=1)
     return _stream
 
@@ -60,6 +81,8 @@ def event(name: str, duration_s: float | None = None, **fields) -> None:
     if not enabled():
         return
     with _lock:
+        if not enabled():         # re-check: racing configure(mode='off')
+            return
         out = _out()
         if _mode == "json":
             rec = {"ts": time.time(), "event": name}

@@ -5,6 +5,18 @@ The reference instruments every public entry with NVTX ranges
 and exposes a Java-side toggle (``pom.xml:86,490``).  The TPU-native
 equivalents are ``jax.named_scope`` (shows up in XLA HLO + xprof) and
 ``jax.profiler`` trace annotations; both degrade to no-ops off-device.
+
+The knob (``SPARK_RAPIDS_TPU_TRACE``) is read at import AND re-checkable at
+runtime: :func:`set_enabled` flips it (parity with
+``structured_log.configure`` — tests and the hot knob need the toggle
+without a process restart).
+
+``@traced`` entries additionally feed two sinks when their knobs are on:
+
+* ``utils.structured_log`` — one event record with wall-time duration per
+  call (the RMM-logging/spdlog analog);
+* ``utils.metrics`` — one span in the per-query span tree (the NVTX range
+  upgraded into a hierarchy; see ``utils/metrics.py``).
 """
 
 from __future__ import annotations
@@ -13,10 +25,26 @@ import contextlib
 import functools
 import os
 import time
+from typing import Optional
 
 import jax
 
-_ENABLED = os.environ.get("SPARK_RAPIDS_TPU_TRACE", "1") not in ("0", "false")
+
+def _read_env() -> bool:
+    return os.environ.get("SPARK_RAPIDS_TPU_TRACE", "1") not in ("0", "false")
+
+
+_ENABLED = _read_env()
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(on: Optional[bool] = None) -> None:
+    """Toggle tracing at runtime; ``None`` re-reads the env knob."""
+    global _ENABLED
+    _ENABLED = _read_env() if on is None else bool(on)
 
 
 @contextlib.contextmanager
@@ -34,22 +62,29 @@ def traced(name: str | None = None):
 
     Also feeds the structured-log knob (``SPARK_RAPIDS_TPU_LOG``,
     ``utils.structured_log``): when enabled, each call emits one event
-    record with wall-time duration — the RMM-logging/spdlog analog."""
+    record with wall-time duration — the RMM-logging/spdlog analog.
+    With metrics on (``SPARK_RAPIDS_TPU_METRICS``, ``utils.metrics``),
+    each call records one span in the current span tree."""
 
     def wrap(fn):
         scope = name or fn.__qualname__
 
         @functools.wraps(fn)
         def inner(*args, **kwargs):
+            from . import metrics
             from . import structured_log as slog
-            if slog.enabled():
-                t0 = time.perf_counter()
+            rec = metrics.recording()
+            log = slog.enabled()
+            if not (rec or log):
                 with func_range(scope):
-                    out = fn(*args, **kwargs)
+                    return fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            ctx = metrics.span(scope) if rec else contextlib.nullcontext()
+            with ctx, func_range(scope):
+                out = fn(*args, **kwargs)
+            if log:
                 slog.event(scope, duration_s=time.perf_counter() - t0)
-                return out
-            with func_range(scope):
-                return fn(*args, **kwargs)
+            return out
 
         return inner
 

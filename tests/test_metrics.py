@@ -1,0 +1,229 @@
+"""Query-level metrics/trace subsystem (utils/metrics.py): registry
+semantics, span-tree nesting, Chrome-trace export round-trip, the
+disabled-mode zero-event guarantee, and the engine/tape counters a small
+end-to-end query must produce."""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu.models.compiled import compile_query
+from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+from spark_rapids_jni_tpu.ops.join import inner_join
+from spark_rapids_jni_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def _metrics_on():
+    metrics.set_enabled(True)
+    metrics.reset()
+    yield
+    metrics.reset()
+    metrics.set_enabled(None)      # back to the env default (off)
+
+
+def _load_trace_report():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "trace_report.py")
+    spec = importlib.util.spec_from_file_location("trace_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --- registry semantics ------------------------------------------------------
+
+
+def test_counter_semantics():
+    metrics.count("c")
+    metrics.count("c")
+    metrics.count("c", 5)
+    assert metrics.snapshot()["counters"]["c"] == 7
+
+
+def test_gauge_and_high_water():
+    metrics.gauge("g", 3)
+    metrics.gauge("g", 1)
+    metrics.gauge_max("hw", 3)
+    metrics.gauge_max("hw", 1)
+    g = metrics.snapshot()["gauges"]
+    assert g["g"] == 1          # plain gauge: last write wins
+    assert g["hw"] == 3         # high-water: max survives
+
+
+def test_histogram_semantics():
+    for v in (1, 3, 1000):
+        metrics.observe("h", v)
+    h = metrics.snapshot()["histograms"]["h"]
+    assert h["count"] == 3 and h["total"] == 1004
+    assert h["min"] == 1 and h["max"] == 1000
+    # log2 buckets: 1 → <=2^1, 3 → <=2^2, 1000 → <=2^10
+    assert h["buckets"] == {"<=2^1": 1, "<=2^2": 1, "<=2^10": 1}
+
+
+def test_span_tree_nesting():
+    with metrics.span("root", q="x"):
+        with metrics.span("child_a"):
+            with metrics.span("leaf"):
+                metrics.annotate(rows=7)
+        with metrics.span("child_b"):
+            pass
+    roots = metrics.span_roots()
+    assert [r["name"] for r in roots] == ["root"]
+    root = roots[0]
+    assert root["attrs"] == {"q": "x"}
+    assert [c["name"] for c in root["children"]] == ["child_a", "child_b"]
+    leaf = root["children"][0]["children"][0]
+    assert leaf["name"] == "leaf" and leaf["attrs"] == {"rows": 7}
+    assert root["dur_ms"] >= root["children"][0]["dur_ms"] >= 0
+    bd = metrics.stage_breakdown()
+    assert bd["root"]["count"] == 1 and bd["leaf"]["count"] == 1
+
+
+def test_disabled_mode_records_nothing():
+    metrics.set_enabled(False)
+    metrics.count("c")
+    metrics.gauge("g", 1)
+    metrics.gauge_max("hw", 1)
+    metrics.observe("h", 1)
+    with metrics.span("s"):
+        metrics.annotate(x=1)
+    assert metrics.snapshot() == {"counters": {}, "gauges": {},
+                                  "histograms": {}}
+    assert metrics.span_roots() == []
+    # the disabled span context is one SHARED object — no per-call alloc
+    assert metrics.span("a") is metrics.span("b")
+
+
+def test_set_enabled_rereads_env(monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_METRICS", "1")
+    metrics.set_enabled(None)
+    assert metrics.enabled()
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_METRICS", "0")
+    metrics.set_enabled(None)
+    assert not metrics.enabled()
+
+
+# --- Chrome-trace export -----------------------------------------------------
+
+
+def test_chrome_trace_roundtrip(tmp_path):
+    metrics.count("events.total", 3)
+    with metrics.span("outer"):
+        with metrics.span("inner", rows=5):
+            pass
+    path = metrics.export_chrome_trace(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"outer", "inner"}
+    inner = next(e for e in xs if e["name"] == "inner")
+    assert inner["args"] == {"rows": 5}
+    outer = next(e for e in xs if e["name"] == "outer")
+    # child nests inside the parent on the timeline
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert doc["srjtCounters"]["events.total"] == 3
+    cs = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    assert any(e["name"] == "events.total" for e in cs)
+
+    # the report tool digests the same file
+    tr = _load_trace_report()
+    events, extras = tr.load_events(path)
+    agg = tr.summarize(events)
+    assert agg["inner"]["count"] == 1
+    assert extras["srjtCounters"]["events.total"] == 3
+    assert "inner" in tr.render(agg)
+
+
+def test_trace_export_default_path_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_METRICS_TRACE",
+                       str(tmp_path / "t.json"))
+    with metrics.span("s"):
+        pass
+    assert metrics.export_chrome_trace() == str(tmp_path / "t.json")
+    assert (tmp_path / "t.json").exists()
+
+
+# --- end-to-end: a small query produces the promised counters ---------------
+
+
+def _tables():
+    f = Table([Column.from_numpy(np.arange(64, dtype=np.int64) % 16),
+               Column.from_numpy(np.arange(64, dtype=np.int32))])
+    d = Table([Column.from_numpy(np.arange(16, dtype=np.int64)),
+               Column.from_numpy((np.arange(16, dtype=np.int32) % 4))])
+    return {"f": f, "d": d}
+
+
+def _q(tables):
+    j = inner_join(tables["f"], tables["d"], 0, 0)
+    # columns: [f.key, f.val, d.key, d.grp] — group by d.grp, sum f.val
+    return groupby_aggregate(j, [3], [(1, "sum")])
+
+
+def test_query_span_tree_and_engine_counters():
+    tables = _tables()
+    with metrics.query_span("small"):
+        _q(tables)
+    roots = metrics.span_roots()
+    assert roots and roots[-1]["name"] == "query:small"
+
+    names: set[str] = set()
+
+    def walk(s):
+        names.add(s["name"])
+        for c in s.get("children", ()):
+            walk(c)
+    walk(roots[-1])
+    # children name the join / groupby / sort stages
+    assert "join.indices" in names
+    assert "groupby.aggregate" in names
+    assert "sort.order_by" in names
+
+    c = metrics.snapshot()["counters"]
+    # dense int64 keys 0..15 pick the dense direct-lookup engine
+    assert c.get("join.engine.dense", 0) >= 1
+    assert c.get("join.build_index.cache_miss", 0) >= 1
+
+    # a second eager run probes the SAME build column buffers — memo hit
+    _q(tables)
+    c = metrics.snapshot()["counters"]
+    assert c.get("join.build_index.cache_hit", 0) >= 1
+
+
+def test_compiled_query_counters():
+    tables = _tables()
+    cq = compile_query(_q, tables)
+    c = metrics.snapshot()["counters"]
+    assert c.get("compiled.capture", 0) == 1
+    h = metrics.snapshot()["histograms"]
+    assert h["compiled.tape_len"]["count"] == 1
+    assert h["compiled.tape_len"]["max"] == len(cq.tape)
+
+    out = cq.run(tables)
+    assert out.num_rows == 4
+    c = metrics.snapshot()["counters"]
+    assert c.get("compiled.replay_run", 0) >= 1
+    # the replay trace itself records the recompile (in_trace counter)
+    assert c.get("compiled.recompile", 0) >= 1
+    bd = metrics.stage_breakdown()
+    assert any(k.startswith("compiled.run:") for k in bd)
+    assert "compiled.dispatch" in bd
+
+    # steady loop with metrics DISABLED takes the raw-dispatch fast path
+    metrics.reset()
+    metrics.set_enabled(False)
+    cq.run_unchecked(tables)
+    assert metrics.snapshot()["counters"] == {}
+
+
+def test_hbm_sampling_records_gauges():
+    live = metrics.sample_hbm()
+    g = metrics.snapshot()["gauges"]
+    assert g["hbm.live_bytes"] == live
+    assert g["hbm.live_bytes.peak"] >= live

@@ -44,3 +44,70 @@ def test_text_mode_and_fields(tmp_path):
         slog.configure(mode="off")
     txt = p.read_text()
     assert "[srjt] custom" in txt and "500.000ms" in txt and "rows=10" in txt
+
+
+def test_off_flip_closes_stream(tmp_path):
+    p = tmp_path / "log.txt"
+    slog.configure(mode="text", path=str(p))
+    slog.event("one")
+    assert slog._stream is not None and not slog._stream.closed
+    slog.configure(mode="off")        # flip must close + reset the stream
+    assert slog._stream is None
+    slog.event("dropped")             # no-op — and must not reopen
+    assert slog._stream is None
+    assert "dropped" not in p.read_text()
+
+
+def test_path_switch_reopens(tmp_path):
+    a, b = tmp_path / "a.txt", tmp_path / "b.txt"
+    slog.configure(mode="text", path=str(a))
+    try:
+        slog.event("first")
+        slog.configure(path=str(b))   # close a, lazily open b on next write
+        slog.event("second")
+    finally:
+        slog.configure(mode="off")
+    assert "first" in a.read_text() and "second" not in a.read_text()
+    assert "second" in b.read_text()
+
+
+def test_event_survives_externally_closed_stream(tmp_path):
+    p = tmp_path / "log.txt"
+    slog.configure(mode="text", path=str(p))
+    try:
+        slog.event("one")
+        slog._stream.close()          # simulate an external close
+        slog.event("two")             # _out() must detect + reopen
+    finally:
+        slog.configure(mode="off")
+    txt = p.read_text()
+    assert "one" in txt and "two" in txt
+
+
+def test_concurrent_events_during_reconfigure(tmp_path):
+    """Writers racing configure() flips never hit a closed stream."""
+    import threading
+
+    p = tmp_path / "log.txt"
+    errors = []
+
+    def writer():
+        for _ in range(200):
+            try:
+                slog.event("w", rows=1)
+            except ValueError as e:     # "I/O operation on closed file"
+                errors.append(e)
+
+    def flipper():
+        for i in range(100):
+            slog.configure(mode="off" if i % 2 else "text", path=str(p))
+
+    slog.configure(mode="text", path=str(p))
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    threads.append(threading.Thread(target=flipper))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    slog.configure(mode="off")
+    assert errors == []

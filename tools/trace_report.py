@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Summarize a Chrome-trace JSON produced by utils/metrics.py.
+
+Aggregates the complete ("ph": "X") span events by name into a top-N table
+(call count, total/max/mean ms, sorted by total time) and prints the
+``srjtCounters`` registry the exporter rides along — the terminal-side
+answer to "where did this query spend its time" without opening Perfetto.
+
+Works on any Chrome-trace file (object format with ``traceEvents`` or a
+bare event array), so it also digests traces from other tools.
+
+Usage: python tools/trace_report.py <trace.json> [top_n]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def load_events(path: str) -> tuple[list[dict], dict]:
+    """→ (trace events, extras dict with srjtCounters/Gauges/Histograms)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):                 # bare event array
+        return doc, {}
+    events = doc.get("traceEvents", [])
+    extras = {k: doc[k] for k in ("srjtCounters", "srjtGauges",
+                                  "srjtHistograms") if k in doc}
+    return events, extras
+
+
+def summarize(events: list[dict]) -> dict[str, dict]:
+    """Aggregate "X" (complete) events by name: count, total/max ms."""
+    agg: dict[str, dict] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        dur_ms = float(ev.get("dur", 0.0)) / 1e3
+        e = agg.setdefault(ev.get("name", "?"),
+                           {"count": 0, "total_ms": 0.0, "max_ms": 0.0})
+        e["count"] += 1
+        e["total_ms"] += dur_ms
+        e["max_ms"] = max(e["max_ms"], dur_ms)
+    return agg
+
+
+def render(agg: dict[str, dict], top_n: int = 20) -> str:
+    rows = sorted(agg.items(), key=lambda kv: -kv[1]["total_ms"])[:top_n]
+    if not rows:
+        return "(no span events)"
+    w = max((len(name) for name, _ in rows), default=4)
+    lines = [f"{'span':<{w}}  {'count':>6}  {'total_ms':>10}  "
+             f"{'mean_ms':>9}  {'max_ms':>9}"]
+    for name, e in rows:
+        mean = e["total_ms"] / e["count"] if e["count"] else 0.0
+        lines.append(f"{name:<{w}}  {e['count']:>6}  "
+                     f"{e['total_ms']:>10.3f}  {mean:>9.3f}  "
+                     f"{e['max_ms']:>9.3f}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) < 2:
+        print(__doc__.strip().splitlines()[-1], file=sys.stderr)
+        return 2
+    path = argv[1]
+    top_n = int(argv[2]) if len(argv) > 2 else 20
+    events, extras = load_events(path)
+    agg = summarize(events)
+    print(f"{path}: {len(events)} events, {len(agg)} distinct spans")
+    print(render(agg, top_n))
+    counters = extras.get("srjtCounters")
+    if counters:
+        print("\ncounters:")
+        w = max(len(k) for k in counters)
+        for k in sorted(counters):
+            v = counters[k]
+            v = int(v) if float(v).is_integer() else v
+            print(f"  {k:<{w}}  {v}")
+    gauges = extras.get("srjtGauges")
+    if gauges:
+        print("\ngauges:")
+        w = max(len(k) for k in gauges)
+        for k in sorted(gauges):
+            print(f"  {k:<{w}}  {gauges[k]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
