@@ -1,0 +1,99 @@
+#!/usr/bin/env bash
+# HBM-arena smoke — the memory-subsystem analog of ci/metrics_smoke.sh:
+# run ONE TPC-DS join query twice (unbudgeted reference, then EAGERLY under
+# a deliberately tiny SRJT_HBM_BUDGET — the index cache is bypassed under
+# capture/replay, so only eager runs register spillable residents), assert
+# the budgeted run recorded at least one spill event in the exported Chrome
+# trace AND produced bit-identical results.  Artifacts land in
+# target/arena_smoke/ for workflow upload.
+#
+# Usage: ci/arena_smoke.sh [n_sales] [query] [budget]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N_SALES="${1:-200000}"
+QUERY="${2:-q3}"
+BUDGET="${3:-2k}"     # tiny on purpose: must undercut the dim-table
+#                       index residents so the second join forces a spill
+OUT=target/arena_smoke
+mkdir -p "$OUT"
+
+echo "== arena smoke: $QUERY over $N_SALES rows, budget $BUDGET =="
+XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+SPARK_RAPIDS_TPU_METRICS=1 \
+SRJT_SMOKE_OUT="$OUT" SRJT_SMOKE_N="$N_SALES" SRJT_SMOKE_Q="$QUERY" \
+SRJT_SMOKE_BUDGET="$BUDGET" \
+python - <<'PYEOF'
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+out = os.environ["SRJT_SMOKE_OUT"]
+n_sales = int(os.environ["SRJT_SMOKE_N"])
+qname = os.environ["SRJT_SMOKE_Q"]
+budget_s = os.environ["SRJT_SMOKE_BUDGET"]
+
+import numpy as np
+
+from benchmarks import tpcds_data
+from spark_rapids_jni_tpu.memory import arena, budget, spill
+from spark_rapids_jni_tpu.models import tpcds
+from spark_rapids_jni_tpu.ops import join_plan
+from spark_rapids_jni_tpu.utils import metrics
+
+files = tpcds_data.generate(n_sales=n_sales, n_items=2_000, n_stores=10,
+                            seed=5)
+tables = tpcds.load_tables(files)
+
+# reference: arena off, eager
+budget.set_enabled(False)
+expect = tpcds.QUERIES[qname](tables)
+
+# budgeted run: cold caches, tiny budget, eager (capture would bypass the
+# index cache and leave nothing to spill)
+join_plan._INDEX_CACHE.clear()
+spill.reset()
+arena.reset()
+budget.reset()
+os.environ["SRJT_HBM_BUDGET"] = budget_s
+budget.set_enabled(None)
+assert budget.active(), "arena did not enable"
+metrics.reset()
+with budget.query_budget(qname, n_sales=n_sales):
+    got = tpcds.QUERIES[qname](tables)
+print(f"{qname}: {got.num_rows} rows under budget {budget_s}")
+
+trace_path = metrics.export_chrome_trace(os.path.join(out, "trace.json"))
+with open(os.path.join(out, "summary.json"), "w") as f:
+    json.dump(metrics.summary(), f, indent=1)
+
+# --- assertions: the acceptance-criterion shape -----------------------------
+assert got.num_rows == expect.num_rows, (got.num_rows, expect.num_rows)
+for i in range(len(expect.columns)):
+    a, b = expect[i], got[i]
+    if a.dtype.id.name == "STRING":
+        assert a.to_pylist() == b.to_pylist(), f"col {i} differs"
+    else:
+        np.testing.assert_array_equal(a.to_numpy(), b.to_numpy(),
+                                      err_msg=f"col {i}")
+print("budgeted result identical to unbudgeted")
+
+with open(trace_path) as f:
+    doc = json.load(f)
+counters = doc["srjtCounters"]
+assert counters.get("arena.spill.events", 0) >= 1, counters
+assert counters.get("arena.spill.bytes", 0) >= 0, counters
+names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+assert f"query:{qname}" in names, sorted(names)
+assert "arena.spill" in names, sorted(names)
+gauges = doc.get("srjtGauges", {})
+print("spill events:", counters["arena.spill.events"],
+      "spill bytes:", counters.get("arena.spill.bytes"),
+      "arena peak:", gauges.get("arena.peak_bytes"))
+print("trace well-formed:", trace_path)
+PYEOF
+
+echo "arena smoke OK"

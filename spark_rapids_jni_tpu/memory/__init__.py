@@ -1,0 +1,29 @@
+"""Pooled device-memory subsystem: slab arena, per-query budgets, spill.
+
+The TPU-native answer to the reference stack's RMM pool + spark-rapids
+spill framework (ROADMAP "HBM arena" item).  Three layers:
+
+* :mod:`.arena`  — size-class slab pool (identity reuse of donated
+  slabs), pooled zeros cache, and accounting reservations for ephemeral
+  buffers; per-device bytes-in-use / high-water gauges.
+* :mod:`.budget` — per-query admission control (:func:`query_budget`
+  composes with ``metrics.query_span``), sized from ``SRJT_HBM_BUDGET``
+  or the recorded ``join.expand.pair_elements`` histogram; strict charges
+  raise :class:`HbmBudgetExceeded`.
+* :mod:`.spill`  — LRU registry of evictable device residents (join
+  build-index cache, promoted host-cache columns) that spill to host RAM
+  under pressure and fault back bit-exactly on touch.
+
+Default **off**: the whole subsystem gates on ``SRJT_HBM_ARENA=1`` (or a
+set ``SRJT_HBM_BUDGET``), and every instrumented call site is one bool
+check away from the pre-arena behavior.
+"""
+
+from . import arena, budget, spill  # noqa: F401
+from .budget import (HbmBudgetExceeded, active, enabled,  # noqa: F401
+                     parse_bytes, query_budget, set_enabled)
+from .arena import reserve  # noqa: F401
+
+__all__ = ["arena", "budget", "spill", "HbmBudgetExceeded", "active",
+           "enabled", "parse_bytes", "query_budget", "reserve",
+           "set_enabled"]

@@ -1,0 +1,261 @@
+"""Size-class slab arena over device memory (RMM pool analog, TPU-flavor).
+
+XLA/PJRT owns physical HBM (its BFC arena is the allocator), and device
+arrays are immutable — so this arena does what an allocation layer CAN do
+above jax, in three tiers:
+
+* **slabs** — ``alloc``/``free``/``trim``: uint8 device buffers rounded up
+  to power-of-two size classes (min 256 B), kept on a per-class free list
+  when freed and handed back by identity on the next matching ``alloc``.
+  Freed-but-pooled slabs keep their HBM reserved (exactly like an RMM
+  pool holds its arena), so a steady-state loop's scratch never churns
+  the BFC allocator; ``trim()`` returns everything.
+* **zeros cache** — ``zeros(shape, dtype)``: join null-fill and empty
+  columns allocate identical all-zero arrays over and over; device arrays
+  are immutable, so ONE pooled instance per (shape, dtype) serves every
+  caller (LRU-capped, ``SRJT_ARENA_ZEROS_CAP``).
+* **reservations** — ``reserve(nbytes)``: accounting-only admission for
+  ephemeral buffers XLA materializes inside a dispatch (join
+  pair-expansion lists — ~10× input on skewed keys — parquet scan slabs,
+  shuffle buckets).  The bytes are charged to ``memory.budget`` for the
+  context's lifetime; pressure spills LRU residents (``memory.spill``)
+  before the dispatch runs.
+
+Per-device bytes-in-use / high-water are tracked for every slab and
+reservation and flow into the ``utils.metrics`` registry as
+``arena.bytes_in_use`` / ``arena.peak_bytes`` /
+``arena.device{i}.bytes_in_use`` gauges (Chrome-trace sidecar included).
+
+Strictness: ``alloc`` is admission-controlled (raises
+:class:`~.budget.HbmBudgetExceeded` over budget); ``reserve`` defaults to
+soft — an admitted query completes with recorded pressure rather than
+failing mid-plan (see ``memory.budget``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from collections import OrderedDict
+
+from ..utils import metrics
+from . import budget
+
+MIN_CLASS = 256
+
+_lock = threading.RLock()
+_free: dict[tuple, list] = {}            # (class, device) → [u8 arrays]
+_zeros: "OrderedDict[tuple, object]" = OrderedDict()
+_zeros_bytes = 0
+
+_in_use = 0          # live slab bytes (class-rounded)
+_pooled = 0          # freed slab bytes retained on free lists
+_peak = 0
+_dev_in_use: dict[int, int] = {}
+_dev_peak: dict[int, int] = {}
+
+
+def size_class(nbytes: int) -> int:
+    """Next power-of-two ≥ nbytes, floored at ``MIN_CLASS`` (alignment:
+    every slab length is a multiple of 256, so any fixed-width dtype view
+    tiles it exactly)."""
+    n = max(int(nbytes), MIN_CLASS)
+    return 1 << (n - 1).bit_length()
+
+
+def _zeros_cap() -> int:
+    return budget.parse_bytes(
+        os.environ.get("SRJT_ARENA_ZEROS_CAP", "16m")) or 0
+
+
+def _device_id(arr) -> int:
+    try:
+        return min(d.id for d in arr.devices())
+    except Exception:
+        return 0
+
+
+class Slab:
+    """One arena buffer: a uint8 device array of ``nbytes`` (the size
+    class) backing a request of ``requested`` bytes."""
+
+    __slots__ = ("data", "nbytes", "requested", "tag", "_freed")
+
+    def __init__(self, data, nbytes: int, requested: int, tag: str):
+        self.data = data
+        self.nbytes = nbytes
+        self.requested = requested
+        self.tag = tag
+        self._freed = False
+
+
+def _note_gauges() -> None:
+    if not metrics.recording():
+        return
+    metrics.gauge("arena.slab_bytes_in_use", _in_use)
+    metrics.gauge("arena.pooled_bytes", _pooled)
+    for i, v in _dev_in_use.items():
+        metrics.gauge(f"arena.device{i}.bytes_in_use", v)
+        metrics.gauge_max(f"arena.device{i}.peak_bytes", _dev_peak[i])
+
+
+def alloc(nbytes: int, tag: str = "scratch") -> Slab:
+    """A device slab of ≥ ``nbytes`` zero bytes.  Reuses a pooled slab of
+    the same size class when one exists (identity reuse — the returned
+    buffer IS the donated one); otherwise admission-checks the budget
+    (strict: raises :class:`~.budget.HbmBudgetExceeded`) and allocates."""
+    global _in_use, _pooled, _peak
+    cls = size_class(nbytes)
+    import jax
+    dev = 0
+    try:
+        dev = jax.local_devices()[0].id
+    except Exception:
+        pass
+    with _lock:
+        stack = _free.get((cls, dev))
+        if stack:
+            data = stack.pop()
+            _pooled -= cls
+            _in_use += cls
+            if metrics.recording():
+                metrics.count("arena.reuse.hits")
+                metrics.count("arena.reuse.bytes", cls)
+            _note_gauges()
+            return Slab(data, cls, int(nbytes), tag)
+    # new slab: admit first so a denied alloc leaves no dangling buffer
+    budget.charge(cls, tag=f"arena.{tag}", strict=True)
+    import jax.numpy as jnp
+    data = jnp.zeros(cls, jnp.uint8)
+    dev = _device_id(data)
+    with _lock:
+        _in_use += cls
+        _peak = max(_peak, _in_use + _pooled)
+        _dev_in_use[dev] = _dev_in_use.get(dev, 0) + cls
+        _dev_peak[dev] = max(_dev_peak.get(dev, 0), _dev_in_use[dev])
+        if metrics.recording():
+            metrics.count("arena.alloc.calls")
+            metrics.count("arena.alloc.bytes", cls)
+        _note_gauges()
+    return Slab(data, cls, int(nbytes), tag)
+
+
+def free(slab: Slab) -> None:
+    """Donate a slab back to its size-class free list.  The HBM stays
+    reserved (pooled) for the next same-class ``alloc``; ``trim()``
+    returns it to the backing allocator and the budget."""
+    global _in_use, _pooled
+    if slab._freed:
+        return
+    slab._freed = True
+    dev = _device_id(slab.data)
+    with _lock:
+        _free.setdefault((slab.nbytes, dev), []).append(slab.data)
+        _in_use -= slab.nbytes
+        _pooled += slab.nbytes
+        _note_gauges()
+    slab.data = None
+
+
+def trim() -> int:
+    """Drop every pooled slab and cached zeros array; returns the bytes
+    released back to the device allocator."""
+    global _pooled, _zeros_bytes
+    with _lock:
+        released = _pooled
+        for (cls, dev), stack in _free.items():
+            d = _dev_in_use
+            d[dev] = max(d.get(dev, 0) - cls * len(stack), 0)
+        _free.clear()
+        _pooled = 0
+        _zeros.clear()
+        _zeros_bytes = 0
+        _note_gauges()
+    budget.release(released)
+    return released
+
+
+def zeros(shape, dtype):
+    """A pooled all-zeros device array (immutable, so one instance per
+    (shape, dtype) serves every caller).  Falls through to a plain
+    ``jnp.zeros`` when the arena is off or a replay trace is active."""
+    global _zeros_bytes
+    import jax.numpy as jnp
+    if not budget.active():
+        return jnp.zeros(shape, dtype)
+    key = (tuple(shape) if isinstance(shape, (tuple, list)) else (shape,),
+           jnp.dtype(dtype).str)
+    with _lock:
+        hit = _zeros.get(key)
+        if hit is not None:
+            _zeros.move_to_end(key)
+            if metrics.recording():
+                metrics.count("arena.zeros.hits")
+            return hit
+    arr = jnp.zeros(shape, dtype)
+    import jax
+    if isinstance(arr, jax.core.Tracer):
+        return arr                       # inside a trace: never pool
+    n = int(arr.nbytes)
+    cap = _zeros_cap()
+    if cap <= 0 or n > cap:
+        return arr                       # pooling off / too big to pool
+    with _lock:
+        _zeros[key] = arr
+        _zeros_bytes += n
+        while _zeros_bytes > cap and len(_zeros) > 1:
+            _, old = _zeros.popitem(last=False)
+            _zeros_bytes -= int(old.nbytes)
+    return arr
+
+
+_NOOP = contextlib.nullcontext()
+
+
+@contextlib.contextmanager
+def _reserve_cm(nbytes: int, tag: str, strict: bool):
+    budget.charge(nbytes, tag=tag, strict=strict)
+    try:
+        yield
+    finally:
+        budget.release(nbytes)
+
+
+def reserve(nbytes: int, tag: str = "ephemeral", *, strict: bool = False):
+    """Admission context for an ephemeral device buffer of known size:
+    charges the budget for the context's lifetime (spilling LRU residents
+    under pressure), releases on exit.  Returns a shared no-op context
+    when the arena is off or a replay trace is active — zero allocation
+    on the gated-off hot path."""
+    if not budget.active() or nbytes <= 0:
+        return _NOOP
+    return _reserve_cm(int(nbytes), tag, strict)
+
+
+def stats() -> dict:
+    """Arena snapshot: slab ledgers, pool occupancy, per-device bytes."""
+    with _lock:
+        return {
+            "slab_bytes_in_use": _in_use,
+            "pooled_bytes": _pooled,
+            "peak_bytes": _peak,
+            "zeros_bytes": _zeros_bytes,
+            "free_slabs": {f"{cls}@{dev}": len(v)
+                           for (cls, dev), v in _free.items() if v},
+            "budget_in_use": budget.in_use(),
+            "budget_peak": budget.peak(),
+            "device_bytes_in_use": dict(_dev_in_use),
+            "device_peak_bytes": dict(_dev_peak),
+        }
+
+
+def reset() -> None:
+    """Drop pools and ledgers (tests)."""
+    global _in_use, _pooled, _peak, _zeros_bytes
+    with _lock:
+        _free.clear()
+        _zeros.clear()
+        _in_use = _pooled = _peak = _zeros_bytes = 0
+        _dev_in_use.clear()
+        _dev_peak.clear()

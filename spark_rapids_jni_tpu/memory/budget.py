@@ -1,0 +1,283 @@
+"""Per-query HBM budgets with admission control (arena subsystem core).
+
+The reference stack sizes an RMM pool once at startup and lets libcudf
+allocate from it; spark-rapids adds per-task memory tracking and a spill
+framework on top (SURVEY §5.5).  The TPU rebuild cannot own raw HBM —
+XLA/PJRT's BFC arena is the allocator — so the budget layer works at the
+level the engine *can* see: every large allocation site (join
+pair-expansion buffers, build-side indexes, parquet scan slabs, shuffle
+buckets) declares its bytes here BEFORE dispatching, and the ledger
+answers admit / spill-then-admit / reject.
+
+Ledger model
+------------
+One process-wide ledger (``in_use`` / ``peak``) plus an optional
+per-query :class:`QueryBudget` stack (thread-local).  The effective limit
+at any charge is the innermost query budget's limit, else the process
+limit from ``SRJT_HBM_BUDGET``.  A charge that would exceed the limit
+first asks ``memory.spill`` to reclaim LRU residents (build-index cache
+entries and friends); if still over:
+
+* ``strict=True``  — the charge rolls back and :class:`HbmBudgetExceeded`
+  raises (explicit-allocation API, ``arena.alloc``).
+* ``strict=False`` — the charge stands and ``arena.budget.soft_over``
+  counts (ephemeral reservations: an admitted query must COMPLETE — the
+  engine cannot spill a buffer XLA is about to materialize, so the soft
+  path records the pressure instead of failing the query).
+
+Sizing
+------
+``SRJT_HBM_BUDGET`` accepts ``512m`` / ``2g`` / plain bytes; empty /
+``none`` / ``unlimited`` means no limit.  Without the env knob,
+:func:`default_limit` sizes the budget from the recorded
+``join.expand.pair_elements`` histogram (PR 2 telemetry): the largest
+observed pair expansion × ~40 bytes/pair × headroom — the measured HBM
+pressure point the ROADMAP names.
+
+Discipline (same as ``utils.metrics``): every public entry gates on one
+module bool; nothing here syncs a device value — all byte counts arrive
+as host ints.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Optional
+
+from ..utils import metrics
+
+# pair-expansion working set per output pair in ops/join.py: pair_ids,
+# left_idx, within, r_pos, right_idx int64 lanes + the matched mask
+PAIR_EXPANSION_BYTES = 40
+_HEADROOM = 4.0
+_FLOOR_BYTES = 64 << 20
+
+_LOCK = threading.RLock()      # shared with memory.spill (lock order:
+#                                budget → spill registry, never reversed)
+
+_enabled: bool = (
+    os.environ.get("SRJT_HBM_ARENA", "0").lower()
+    not in ("0", "off", "false", "")
+    or bool(os.environ.get("SRJT_HBM_BUDGET")))
+
+
+class HbmBudgetExceeded(RuntimeError):
+    """A strict charge exceeded the active budget even after spilling."""
+
+    def __init__(self, requested: int, in_use: int, limit: int,
+                 query: Optional[str], tag: str):
+        self.requested = int(requested)
+        self.in_use = int(in_use)
+        self.limit = int(limit)
+        self.query = query
+        self.tag = tag
+        super().__init__(
+            f"HBM budget exceeded: {tag} wants {requested} B with "
+            f"{in_use} B in use, limit {limit} B"
+            + (f" (query {query})" if query else "")
+            + " — raise SRJT_HBM_BUDGET or free residents")
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: Optional[bool] = None) -> None:
+    """Toggle the arena subsystem; ``None`` re-reads the env knobs."""
+    global _enabled
+    if on is None:
+        _enabled = (os.environ.get("SRJT_HBM_ARENA", "0").lower()
+                    not in ("0", "off", "false", "")
+                    or bool(os.environ.get("SRJT_HBM_BUDGET")))
+    else:
+        _enabled = bool(on)
+
+
+def active() -> bool:
+    """True when charges should be taken NOW: arena on, and not inside a
+    ``syncs.replay`` re-trace (the replay re-runs plan Python whose
+    allocations were already admitted by the capture run)."""
+    if not _enabled:
+        return False
+    from ..utils import syncs
+    return syncs.mode() != "replay"
+
+
+def parse_bytes(s) -> Optional[int]:
+    """``"512m"`` / ``"2g"`` / ``"65536"`` → bytes; None/empty/``none``/
+    ``unlimited`` → None (no limit)."""
+    if s is None:
+        return None
+    if isinstance(s, (int, float)):
+        return int(s)
+    t = s.strip().lower()
+    if t in ("", "none", "unlimited", "off"):
+        return None
+    mult = 1
+    if t[-1] in "kmgt":
+        mult = 1 << (10 * ("kmgt".index(t[-1]) + 1))
+        t = t[:-1]
+    return int(float(t) * mult)
+
+
+class QueryBudget:
+    """One query's admission scope: a limit plus its own peak tracking."""
+
+    __slots__ = ("name", "limit", "charged", "peak", "spills_at_entry")
+
+    def __init__(self, name: str, limit: Optional[int]):
+        self.name = name
+        self.limit = limit
+        self.charged = 0           # bytes this query charged (net)
+        self.peak = 0              # high-water of the PROCESS ledger
+
+
+class _Ledger:
+    __slots__ = ("in_use", "peak")
+
+    def __init__(self):
+        self.in_use = 0
+        self.peak = 0
+
+
+_process = _Ledger()
+_tls = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current() -> Optional[QueryBudget]:
+    st = getattr(_tls, "stack", None)
+    return st[-1] if st else None
+
+
+def process_limit() -> Optional[int]:
+    return parse_bytes(os.environ.get("SRJT_HBM_BUDGET"))
+
+
+def limit_now() -> Optional[int]:
+    q = current()
+    if q is not None and q.limit is not None:
+        return q.limit
+    return process_limit()
+
+
+def default_limit() -> Optional[int]:
+    """Budget sized from the recorded pair-expansion histogram (PR 2):
+    largest observed expansion × ~40 B/pair × headroom, floored at 64 MiB.
+    None (unlimited) when neither the env knob nor the histogram exist."""
+    env = process_limit()
+    if env is not None:
+        return env
+    h = metrics.snapshot()["histograms"].get("join.expand.pair_elements")
+    if not h:
+        return None
+    return max(int(h["max"] * PAIR_EXPANSION_BYTES * _HEADROOM),
+               _FLOOR_BYTES)
+
+
+def in_use() -> int:
+    return _process.in_use
+
+
+def peak() -> int:
+    return _process.peak
+
+
+def reset() -> None:
+    """Zero the ledgers (tests)."""
+    with _LOCK:
+        _process.in_use = 0
+        _process.peak = 0
+        _tls.stack = []
+
+
+def _note_gauges() -> None:
+    if metrics.recording():
+        metrics.gauge("arena.bytes_in_use", _process.in_use)
+        metrics.gauge_max("arena.peak_bytes", _process.peak)
+
+
+def charge(nbytes: int, tag: str = "buf", *, strict: bool = False) -> bool:
+    """Admit ``nbytes`` against the active budget.
+
+    Over-limit charges first ask the spill registry to reclaim the
+    deficit from LRU residents.  Returns True when the charge fits (or no
+    limit applies); strict charges raise :class:`HbmBudgetExceeded`
+    instead of standing over-limit."""
+    if not active() or nbytes <= 0:
+        return True
+    n = int(nbytes)
+    with _LOCK:
+        _process.in_use += n
+        limit = limit_now()
+        if limit is not None and _process.in_use > limit:
+            from . import spill
+            spill.reclaim(_process.in_use - limit)
+        fits = limit is None or _process.in_use <= limit
+        if not fits and strict:
+            _process.in_use -= n
+            q = current()
+            if metrics.recording():
+                metrics.count("arena.budget.denied")
+            raise HbmBudgetExceeded(n, _process.in_use, limit,
+                                    q.name if q else None, tag)
+        _process.peak = max(_process.peak, _process.in_use)
+        q = current()
+        if q is not None:
+            q.charged += n
+            q.peak = max(q.peak, _process.in_use)
+        if not fits and metrics.recording():
+            metrics.count("arena.budget.soft_over")
+        _note_gauges()
+        return fits
+
+
+def release(nbytes: int) -> None:
+    if not _enabled or nbytes <= 0:
+        return
+    with _LOCK:
+        _process.in_use = max(_process.in_use - int(nbytes), 0)
+        q = current()
+        if q is not None:
+            q.charged -= int(nbytes)
+        _note_gauges()
+
+
+@contextlib.contextmanager
+def query_budget(name: str, limit_bytes=None, **attrs):
+    """Per-query admission scope, composed with ``metrics.query_span``.
+
+    ``limit_bytes`` accepts ints or ``"512m"`` strings; None sizes from
+    ``SRJT_HBM_BUDGET`` / the pair-expansion histogram
+    (:func:`default_limit`).  On exit the query span is annotated with the
+    arena peak and the query's net spill activity, so Chrome traces carry
+    the budget story next to the stage tree."""
+    limit = parse_bytes(limit_bytes) if limit_bytes is not None \
+        else default_limit()
+    q = QueryBudget(name, limit)
+    snap0 = metrics.snapshot()["counters"] if metrics.recording() else {}
+    with metrics.query_span(name, budget_bytes=limit or 0, **attrs) as sp:
+        _stack().append(q)
+        try:
+            yield q
+        finally:
+            st = _stack()
+            if st and st[-1] is q:
+                st.pop()
+            if sp is not None:
+                snap1 = metrics.snapshot()["counters"]
+                sp.annotate(
+                    arena_peak_bytes=q.peak,
+                    arena_spills=int(
+                        snap1.get("arena.spill.events", 0)
+                        - snap0.get("arena.spill.events", 0)))
+            if metrics.recording():
+                metrics.gauge_max("arena.query.peak_bytes", q.peak)

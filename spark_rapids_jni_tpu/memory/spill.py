@@ -1,0 +1,162 @@
+"""LRU registry of evictable device residents with host-spill/fault-back.
+
+The spark-rapids analog is ``RapidsBufferCatalog`` + the device→host→disk
+spill tiers: long-lived device residents (cached build-side join indexes,
+promoted host-cache columns, parquet scan slabs) register here with their
+byte footprint; when ``memory.budget`` sees pressure it walks this
+registry in LRU order and asks residents to spill.
+
+Spilling at this layer moves a resident's device arrays to pinned-enough
+host RAM (``np.asarray`` — on the remote-TPU backend that is the tunnel
+D2H; on CPU it is a view-copy) and drops the device references so XLA's
+BFC arena can actually reuse the HBM.  Faulting back is ``jnp.asarray``
+on next touch.  All payloads in this engine are integer/bit-pattern
+arrays (FLOAT64 is stored as u32 bit pairs — the Column invariant), so a
+spill→fault-back round trip is bit-exact on every backend.
+
+Residents must be *re-derivable or self-contained*: the registry never
+spills buffers a running plan holds references to — only caches that can
+fault back (or rebuild) on their next touch.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..utils import metrics
+from . import budget
+
+_reg: "OrderedDict[object, Resident]" = OrderedDict()
+
+
+class Resident:
+    """One evictable device-resident entry.
+
+    ``spiller()`` must free the resident's device references and return
+    the bytes it released; after it runs the entry leaves the registry
+    (a fault-back re-registers it)."""
+
+    __slots__ = ("key", "nbytes", "tag", "spiller")
+
+    def __init__(self, key, nbytes: int, tag: str,
+                 spiller: Callable[[], int]):
+        self.key = key
+        self.nbytes = int(nbytes)
+        self.tag = tag
+        self.spiller = spiller
+
+
+def register(key, nbytes: int, tag: str,
+             spiller: Callable[[], int]) -> None:
+    """Track a device resident as evictable; charges the budget (soft —
+    registering a cache entry must not fail the query; pressure instead
+    spills older residents, possibly including this one later)."""
+    if not budget.active():
+        return
+    budget.charge(nbytes, tag=tag, strict=False)
+    with budget._LOCK:
+        _reg[key] = Resident(key, nbytes, tag, spiller)
+        _reg.move_to_end(key)
+
+
+def unregister(key, *, release: bool = True) -> None:
+    """Drop a resident (evicted, died with its arrays, or spilled)."""
+    with budget._LOCK:
+        r = _reg.pop(key, None)
+    if r is not None and release:
+        budget.release(r.nbytes)
+
+
+def touch(key) -> None:
+    """Mark a resident most-recently-used."""
+    with budget._LOCK:
+        if key in _reg:
+            _reg.move_to_end(key)
+
+
+def registered_bytes() -> int:
+    with budget._LOCK:
+        return sum(r.nbytes for r in _reg.values())
+
+
+def resident_count() -> int:
+    return len(_reg)
+
+
+def reset() -> None:
+    """Forget every resident without spilling (tests)."""
+    with budget._LOCK:
+        _reg.clear()
+
+
+def reclaim(nbytes_needed: int) -> int:
+    """Spill LRU residents until ``nbytes_needed`` bytes were released
+    (or the registry runs dry).  Returns bytes actually freed."""
+    freed = 0
+    while freed < nbytes_needed:
+        with budget._LOCK:
+            if not _reg:
+                break
+            key, r = next(iter(_reg.items()))
+            _reg.pop(key, None)
+        with metrics.span("arena.spill", tag=r.tag, bytes=r.nbytes):
+            try:
+                got = int(r.spiller())
+            except Exception:
+                got = 0
+        budget.release(r.nbytes)
+        freed += got or r.nbytes
+        if metrics.recording():
+            metrics.count("arena.spill.events")
+            metrics.count("arena.spill.bytes", r.nbytes)
+            metrics.count(f"arena.spill.{r.tag}")
+    return freed
+
+
+class SpillableArrays:
+    """A named bundle of device arrays that can round-trip through host
+    RAM bit-exactly (the generic resident payload: build-index lanes,
+    promoted columns).
+
+    ``get()`` returns the device-array dict, faulting back from the host
+    copies when spilled (counted as ``arena.faultback.*``); ``spill()``
+    moves every array to host and drops the device references."""
+
+    __slots__ = ("tag", "_dev", "_host", "nbytes")
+
+    def __init__(self, tag: str, arrays: dict):
+        self.tag = tag
+        self._dev: Optional[dict] = {k: v for k, v in arrays.items()}
+        self._host: Optional[dict] = None
+        self.nbytes = sum(int(getattr(a, "nbytes", 0) or 0)
+                          for a in arrays.values() if a is not None)
+
+    @property
+    def spilled(self) -> bool:
+        return self._dev is None
+
+    def spill(self) -> int:
+        """Device → host; returns bytes released (0 when already host)."""
+        if self._dev is None:
+            return 0
+        self._host = {k: (None if a is None else np.asarray(a))
+                      for k, a in self._dev.items()}
+        self._dev = None
+        return self.nbytes
+
+    def get(self) -> dict:
+        """The device-array dict, faulting back if spilled."""
+        if self._dev is None:
+            import jax.numpy as jnp
+            with metrics.span("arena.faultback", tag=self.tag,
+                              bytes=self.nbytes):
+                self._dev = {k: (None if a is None else jnp.asarray(a))
+                             for k, a in self._host.items()}
+            self._host = None
+            if metrics.recording():
+                metrics.count("arena.faultback.events")
+                metrics.count("arena.faultback.bytes", self.nbytes)
+        return self._dev

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..column import Column, Table
+from ..memory import arena
+from ..memory.budget import PAIR_EXPANSION_BYTES
 from ..utils import metrics, syncs
 from .filter import gather
 
@@ -113,20 +115,24 @@ def _join_indices(left: Column, right: Column, how: str):
         metrics.count("join.expand.calls")
         metrics.observe("join.expand.pair_elements", total)
         metrics.annotate(expand_pairs=total)
-    starts = jnp.cumsum(out_counts) - out_counts
-    pair_ids = jnp.arange(total, dtype=jnp.int64)
-    # row of each output pair: inverse of starts (searchsorted right)
-    left_idx = jnp.searchsorted(starts.astype(jnp.int64), pair_ids,
-                                side="right") - 1
-    within = pair_ids - starts.astype(jnp.int64)[left_idx]
-    matched = within < counts[left_idx]
-    if nr == 0:
-        right_idx = jnp.full(left_idx.shape, -1, dtype=jnp.int64)
-    else:
-        r_pos = lo[left_idx] + jnp.where(matched, within, 0)
-        right_idx = jnp.where(
-            matched, ix.row_ids[jnp.minimum(r_pos, nr - 1)], -1)
-    return left_idx, right_idx
+    # admission-control the ephemeral expansion working set (the int64
+    # lanes + mask below) before XLA materializes it; under pressure this
+    # spills LRU arena residents first (soft: an admitted query completes)
+    with arena.reserve(total * PAIR_EXPANSION_BYTES, tag="join.expand"):
+        starts = jnp.cumsum(out_counts) - out_counts
+        pair_ids = jnp.arange(total, dtype=jnp.int64)
+        # row of each output pair: inverse of starts (searchsorted right)
+        left_idx = jnp.searchsorted(starts.astype(jnp.int64), pair_ids,
+                                    side="right") - 1
+        within = pair_ids - starts.astype(jnp.int64)[left_idx]
+        matched = within < counts[left_idx]
+        if nr == 0:
+            right_idx = jnp.full(left_idx.shape, -1, dtype=jnp.int64)
+        else:
+            r_pos = lo[left_idx] + jnp.where(matched, within, 0)
+            right_idx = jnp.where(
+                matched, ix.row_ids[jnp.minimum(r_pos, nr - 1)], -1)
+        return left_idx, right_idx
 
 
 def inner_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:
@@ -140,38 +146,38 @@ def inner_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:
 def _empty_column(dt) -> Column:
     from .. import types as T
     if dt.id == T.TypeId.LIST:
-        return Column(dt, jnp.zeros(0, jnp.uint8), jnp.zeros(1, jnp.int32),
+        return Column(dt, arena.zeros(0, jnp.uint8), arena.zeros(1, jnp.int32),
                       None, [_empty_column(dt.children[0])])
     if dt.id == T.TypeId.STRUCT:
-        return Column(dt, jnp.zeros(0, jnp.uint8), None, None,
+        return Column(dt, arena.zeros(0, jnp.uint8), None, None,
                       [_empty_column(f) for f in dt.children])
     if dt.is_variable_width:
-        return Column(dt, jnp.zeros(0, jnp.uint8), jnp.zeros(1, jnp.int32))
+        return Column(dt, arena.zeros(0, jnp.uint8), arena.zeros(1, jnp.int32))
     if dt.id == T.TypeId.DECIMAL128:
-        return Column(dt, jnp.zeros((0, 2), jnp.int64))
+        return Column(dt, arena.zeros((0, 2), jnp.int64))
     if dt.id == T.TypeId.FLOAT64:     # bit-pair storage invariant
-        return Column(dt, jnp.zeros((0, 2), jnp.uint32))
-    return Column(dt, jnp.zeros(0, dt.storage))
+        return Column(dt, arena.zeros((0, 2), jnp.uint32))
+    return Column(dt, arena.zeros(0, dt.storage))
 
 
 def _null_column(dt, n: int) -> Column:
     from .. import types as T
-    nulls = jnp.zeros(n, jnp.bool_)
+    nulls = arena.zeros(n, jnp.bool_)
     if dt.id == T.TypeId.LIST:
-        return Column(dt, jnp.zeros(0, jnp.uint8),
-                      jnp.zeros(n + 1, jnp.int32), nulls,
+        return Column(dt, arena.zeros(0, jnp.uint8),
+                      arena.zeros(n + 1, jnp.int32), nulls,
                       [_empty_column(dt.children[0])])
     if dt.id == T.TypeId.STRUCT:
-        return Column(dt, jnp.zeros(0, jnp.uint8), None, nulls,
+        return Column(dt, arena.zeros(0, jnp.uint8), None, nulls,
                       [_null_column(f, n) for f in dt.children])
     if dt.is_variable_width:
-        return Column(dt, jnp.zeros(0, jnp.uint8),
-                      jnp.zeros(n + 1, jnp.int32), nulls)
+        return Column(dt, arena.zeros(0, jnp.uint8),
+                      arena.zeros(n + 1, jnp.int32), nulls)
     if dt.id == T.TypeId.DECIMAL128:
-        return Column(dt, jnp.zeros((n, 2), jnp.int64), validity=nulls)
+        return Column(dt, arena.zeros((n, 2), jnp.int64), validity=nulls)
     if dt.id == T.TypeId.FLOAT64:     # bit-pair storage invariant
-        return Column(dt, jnp.zeros((n, 2), jnp.uint32), validity=nulls)
-    return Column(dt, jnp.zeros(n, dt.storage), validity=nulls)
+        return Column(dt, arena.zeros((n, 2), jnp.uint32), validity=nulls)
+    return Column(dt, arena.zeros(n, dt.storage), validity=nulls)
 
 
 def left_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:

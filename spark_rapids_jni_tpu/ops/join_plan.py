@@ -34,16 +34,25 @@ instead of silently probing the wrong window).
 Build-side index cache
 ----------------------
 Indexes are memoized on the key buffers' device-array identity
-(``syncs.memo_get/put`` — weakref'd, entries drop with the arrays, and
-the memo is automatically disabled under capture/replay so tapes stay
-aligned).  A dimension table is therefore sorted/indexed ONCE per process
-and reused across every join of every query in a suite run.
+(weakref'd, entries drop with the arrays, and the cache is automatically
+disabled under capture/replay so tapes stay aligned).  A dimension table
+is therefore sorted/indexed ONCE per process and reused across every
+join of every query in a suite run.
+
+Since the HBM-arena PR the cache is capacity-bounded and evictable: each
+entry's device footprint is LRU-tracked against ``SRJT_INDEX_CACHE_CAP``
+(cap overflow drops the LRU entry — ``join.build_index.evictions``), and
+when the arena is enabled entries register with ``memory.spill`` so
+budget pressure moves their lanes to host RAM; a later cache hit faults
+them back bit-exactly (``join.build_index.faultback``).
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
+import weakref
+from collections import OrderedDict
 from typing import NamedTuple, Optional, Sequence
 
 import jax
@@ -91,6 +100,135 @@ class BuildIndex(NamedTuple):
     unique: bool                         # dense: every slot holds ≤ 1 row
 
 
+def _index_nbytes(ix: "BuildIndex") -> int:
+    return sum(int(a.nbytes) for a in
+               (ix.row_ids, ix.sorted_keys, ix.lut_lo, ix.lut_cnt)
+               if a is not None)
+
+
+class _IndexCache:
+    """LRU build-index cache keyed on key-buffer identity, capacity-bound
+    and arena-evictable (the fix for the PR 1 unbounded memo).
+
+    * plain LRU over device bytes: inserting past ``SRJT_INDEX_CACHE_CAP``
+      drops the least-recently-used entry (``join.build_index.evictions``).
+    * arena tier (``SRJT_HBM_ARENA``/``SRJT_HBM_BUDGET`` set): entries
+      register as ``memory.spill`` residents; budget pressure spills their
+      lanes to host RAM, and the next cache hit faults them back.
+    * entries die with their key arrays (weakref callbacks) and the cache
+      is bypassed under syncs capture/replay, exactly like the old memo.
+    """
+
+    def __init__(self):
+        self._d: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._device_bytes = 0
+
+    @staticmethod
+    def _cap() -> Optional[int]:
+        from ..memory import budget as mbudget
+        return mbudget.parse_bytes(
+            os.environ.get("SRJT_INDEX_CACHE_CAP", "512m"))
+
+    def _drop(self, key, *, count_eviction: bool) -> None:
+        e = self._d.pop(key, None)
+        if e is None:
+            return
+        from ..memory import spill as mspill
+        if not e["payload"].spilled:
+            self._device_bytes -= e["nbytes"]
+            mspill.unregister(("join_index",) + key)
+        if count_eviction and metrics.recording():
+            metrics.count("join.build_index.evictions")
+
+    def get(self, tag: str, arrays) -> Optional["BuildIndex"]:
+        if syncs.mode() != "normal":
+            return None
+        key = (tag,) + tuple(id(a) for a in arrays)
+        e = self._d.get(key)
+        if e is None:
+            return None
+        for r, a in zip(e["refs"], arrays):
+            if r() is not a:
+                return None
+        self._d.move_to_end(key)
+        from ..memory import spill as mspill
+        if e["payload"].spilled:
+            lanes = e["payload"].get()          # fault back (bit-exact)
+            kind, n_valid, kmin, span, unique = e["meta"]
+            e["value"] = BuildIndex(kind, n_valid, lanes["row_ids"],
+                                    lanes["sorted_keys"], kmin, span,
+                                    lanes["lut_lo"], lanes["lut_cnt"],
+                                    unique)
+            self._device_bytes += e["nbytes"]
+            mspill.register(("join_index",) + key, e["nbytes"],
+                            "join.build_index", e["payload"].spill)
+            if metrics.recording():
+                metrics.count("join.build_index.faultback")
+            self._evict_over_cap(keep=key)
+        else:
+            mspill.touch(("join_index",) + key)
+        return e["value"]
+
+    def _evict_over_cap(self, keep=None) -> None:
+        cap = self._cap()
+        if cap is None:
+            return
+        while self._device_bytes > cap and len(self._d) > 1:
+            lru = next(k for k in self._d if k != keep) \
+                if keep is not None else next(iter(self._d))
+            self._drop(lru, count_eviction=True)
+            if lru == keep:
+                break
+
+    def put(self, tag: str, arrays, ix: "BuildIndex") -> None:
+        if syncs.mode() != "normal":
+            return
+        key = (tag,) + tuple(id(a) for a in arrays)
+        try:
+            refs = tuple(
+                weakref.ref(a, lambda _, k=key: self._drop(
+                    k, count_eviction=False))
+                for a in arrays)
+        except TypeError:
+            return
+        from ..memory import spill as mspill
+        payload = mspill.SpillableArrays(
+            "join.build_index",
+            {"row_ids": ix.row_ids, "sorted_keys": ix.sorted_keys,
+             "lut_lo": ix.lut_lo, "lut_cnt": ix.lut_cnt})
+        entry = {"refs": refs, "value": ix, "payload": payload,
+                 "nbytes": payload.nbytes,
+                 "meta": (ix.kind, ix.n_valid, ix.kmin, ix.span,
+                          ix.unique)}
+
+        def _spiller(e=entry):
+            freed = e["payload"].spill()
+            if freed:
+                e["value"] = None               # drop the device refs
+                self._device_bytes -= e["nbytes"]
+            return freed
+
+        self._d[key] = entry
+        self._device_bytes += entry["nbytes"]
+        mspill.register(("join_index",) + key, entry["nbytes"],
+                        "join.build_index", _spiller)
+        self._evict_over_cap(keep=key)
+
+    def clear(self) -> None:
+        from ..memory import spill as mspill
+        for key, e in list(self._d.items()):
+            if not e["payload"].spilled:
+                mspill.unregister(("join_index",) + key)
+        self._d.clear()
+        self._device_bytes = 0
+
+    def device_bytes(self) -> int:
+        return self._device_bytes
+
+
+_INDEX_CACHE = _IndexCache()
+
+
 def dense_eligible(col: Column) -> bool:
     """Key dtypes the direct-lookup window arithmetic is exact for."""
     dt = col.dtype
@@ -105,11 +243,12 @@ def dense_eligible(col: Column) -> bool:
 
 
 def build_index(data: jnp.ndarray, valid, dense_ok: bool) -> BuildIndex:
-    """Index the build side, memoized on the key buffers' identity."""
+    """Index the build side, memoized on the key buffers' identity
+    (capacity-bound LRU; arena-evictable — see :class:`_IndexCache`)."""
     forced = forced_engine()
     tag = f"join_build_index:{forced or 'auto'}"
     key_arrays = (data,) if valid is None else (data, valid)
-    hit = syncs.memo_get(tag, key_arrays)
+    hit = _INDEX_CACHE.get(tag, key_arrays)
     if hit is not None:
         if metrics.recording():
             metrics.count("join.build_index.cache_hit")
@@ -123,7 +262,7 @@ def build_index(data: jnp.ndarray, valid, dense_ok: bool) -> BuildIndex:
             metrics.count(f"join.engine.{ix.kind}")
             metrics.annotate(engine=ix.kind, n_valid=ix.n_valid,
                              key_span=ix.span)
-    syncs.memo_put(tag, key_arrays, ix)
+    _INDEX_CACHE.put(tag, key_arrays, ix)
     return ix
 
 

@@ -316,5 +316,16 @@ def repartition_join_agg_auto(mesh: jax.sharding.Mesh,
         fact_capacity=_bucket_capacity(needs[0]),
         build_capacity=_bucket_capacity(needs[1]),
         key_min=key_min, key_span=key_span)
-    return repartition_join_agg(mesh, spec, fact_datas, fact_valid,
-                                build_datas, build_valid, axis_name)
+    # arena admission for the exchange's padded bucket buffers (both
+    # sides), sized from the measured capacities before dispatch
+    from .shuffle import bucket_reservation
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    P = int(np.prod([mesh.shape[a] for a in axes]))
+    row_bytes = [sum(np.dtype(a.dtype).itemsize for a in datas) + len(datas)
+                 for datas in (fact_datas, build_datas)]
+    with bucket_reservation(P, spec.fact_capacity, row_bytes[0],
+                            tag="shuffle.fact"), \
+         bucket_reservation(P, spec.build_capacity, row_bytes[1],
+                            tag="shuffle.build"):
+        return repartition_join_agg(mesh, spec, fact_datas, fact_valid,
+                                    build_datas, build_valid, axis_name)

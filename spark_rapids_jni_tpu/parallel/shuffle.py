@@ -71,6 +71,20 @@ def bucketize_rows(rows: jnp.ndarray, part_id: jnp.ndarray,
     return Buckets(buckets, clipped, dropped)
 
 
+def bucket_reservation(num_partitions: int, capacity: int,
+                       row_nbytes: int, sides: int = 1, tag: str = "shuffle"):
+    """HBM-arena admission context for a sized exchange's padded bucket
+    buffers: every shard materializes a ``[P, capacity, row_size]`` send
+    buffer and receives its transpose, so the mesh-wide footprint is
+    ``P² · capacity · row_bytes`` per side.  Call around the sized
+    dispatch (eager code — never inside shard_map); no-op when the arena
+    is off."""
+    from ..memory import arena
+    nbytes = (int(num_partitions) ** 2 * int(capacity) * int(row_nbytes)
+              * int(sides))
+    return arena.reserve(nbytes, tag=tag)
+
+
 def all_to_all_shuffle(buckets: Buckets, axis_name: str) -> Buckets:
     """Exchange buckets across the mesh axis (must run inside shard_map).
 

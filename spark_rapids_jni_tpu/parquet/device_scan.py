@@ -896,7 +896,13 @@ def scan_table(file_bytes: bytes,
         plan = tuple((key, statics, len(args))
                      for _, key, statics, args, _ in deferred)
         flat = tuple(a for _, _, _, args, _ in deferred for a in args)
-        outs = _decode_file_jit(plan, flat)
+        # admission for the fused scan's staged input slabs (the decode
+        # outputs are the table itself — not ephemeral — so only the raw
+        # page/dictionary buffers are reserved)
+        from ..memory import arena
+        scan_bytes = sum(int(getattr(a, "nbytes", 0) or 0) for a in flat)
+        with arena.reserve(scan_bytes, tag="parquet.scan"):
+            outs = _decode_file_jit(plan, flat)
         for (i, _, _, _, assemble), out in zip(deferred, outs):
             by_index[i] = assemble(out)
     if metrics.recording():

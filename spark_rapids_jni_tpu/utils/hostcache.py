@@ -10,13 +10,19 @@ get their host copy back for free instead of re-downloading it.
 
 Entries are keyed by the device array's identity and dropped by a weakref
 callback when the device array is garbage-collected.  The cache is an
-optimization only — a miss falls back to the transfer.
+optimization only — a miss falls back to the transfer.  The host-mirror
+instance is additionally byte-capped (``SRJT_HOSTCACHE_CAP``, default
+256 MiB): past the cap the least-recently-used mirror is dropped and
+``arena.hostcache.evictions`` counts it — long scans over many files no
+longer grow host RSS without bound.
 """
 
 from __future__ import annotations
 
+import os
 import weakref
-from typing import Any
+from collections import OrderedDict
+from typing import Any, Callable, Optional
 
 import numpy as np
 
@@ -30,33 +36,80 @@ class WeakIdMemo:
     any keyed array is garbage-collected, and an ``is``-identity check
     guards against id recycling.  Best-effort: non-weakref-able keys are
     simply not cached.
+
+    ``cap_bytes`` (a value or a zero-arg callable, None = unbounded)
+    turns the memo into a byte-capped LRU over ``value.nbytes``;
+    ``on_evict`` fires once per capacity eviction (not for weakref
+    deaths).
     """
 
-    def __init__(self) -> None:
-        self._d: dict[tuple, tuple] = {}
+    def __init__(self, cap_bytes=None,
+                 on_evict: Optional[Callable[[], None]] = None) -> None:
+        self._d: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._bytes = 0
+        self._cap = cap_bytes
+        self._on_evict = on_evict
+
+    def _cap_now(self) -> Optional[int]:
+        c = self._cap
+        return c() if callable(c) else c
+
+    def _pop(self, key) -> None:
+        entry = self._d.pop(key, None)
+        if entry is not None:
+            self._bytes -= entry[2]
 
     def get(self, arrays) -> Any:
-        entry = self._d.get(tuple(id(a) for a in arrays))
+        key = tuple(id(a) for a in arrays)
+        entry = self._d.get(key)
         if entry is None:
             return None
-        refs, value = entry
+        refs, value, _ = entry
         for r, a in zip(refs, arrays):
             if r() is not a:
                 return None
+        self._d.move_to_end(key)
         return value
 
     def put(self, arrays, value) -> None:
         key = tuple(id(a) for a in arrays)
         try:
             refs = tuple(
-                weakref.ref(a, lambda _, k=key: self._d.pop(k, None))
+                weakref.ref(a, lambda _, k=key: self._pop(k))
                 for a in arrays)
         except TypeError:
             return
-        self._d[key] = (refs, value)
+        nbytes = int(getattr(value, "nbytes", 0) or 0)
+        self._pop(key)
+        self._d[key] = (refs, value, nbytes)
+        self._bytes += nbytes
+        cap = self._cap_now()
+        if cap is None:
+            return
+        while self._bytes > cap and len(self._d) > 1:
+            lru = next(iter(self._d))
+            if lru == key:
+                break
+            self._pop(lru)
+            if self._on_evict is not None:
+                self._on_evict()
+
+    def nbytes(self) -> int:
+        return self._bytes
 
 
-_HOST = WeakIdMemo()
+def _host_cap() -> Optional[int]:
+    from ..memory.budget import parse_bytes
+    return parse_bytes(os.environ.get("SRJT_HOSTCACHE_CAP", "256m"))
+
+
+def _count_host_eviction() -> None:
+    from . import metrics
+    if metrics.recording():
+        metrics.count("arena.hostcache.evictions")
+
+
+_HOST = WeakIdMemo(cap_bytes=_host_cap, on_evict=_count_host_eviction)
 
 
 def seed(device_arr, host_arr: np.ndarray) -> None:

@@ -234,19 +234,24 @@ def query_span(name: str, **attrs):
 
 def sample_hbm(tag: str = "sample") -> Optional[int]:
     """Sample live device memory: sum of ``jax.live_arrays()`` byte sizes
-    plus per-device allocator stats where the backend exposes them.
-    Updates ``hbm.live_bytes`` and the ``hbm.live_bytes.peak`` high-water
-    gauge; returns the live-byte total (None when disabled)."""
+    plus per-device allocator stats where the backend exposes them.  On
+    backends with no ``memory_stats()`` (CPU, some PJRT builds) the
+    per-device gauges fall back to an estimate from ``jax.live_arrays()``
+    grouped by placement (sharded arrays split evenly across their
+    devices).  Updates ``hbm.live_bytes`` and the ``hbm.live_bytes.peak``
+    high-water gauge; returns the live-byte total (None when disabled)."""
     if not recording():
         return None
     import jax
+    arrays = []
     try:
-        live = sum(int(getattr(a, "nbytes", 0) or 0)
-                   for a in jax.live_arrays())
+        arrays = list(jax.live_arrays())
     except Exception:
-        live = 0
+        pass
+    live = sum(int(getattr(a, "nbytes", 0) or 0) for a in arrays)
     gauge("hbm.live_bytes", live)
     gauge_max("hbm.live_bytes.peak", live)
+    any_stats = False
     try:
         for i, d in enumerate(jax.local_devices()):
             stats = getattr(d, "memory_stats", None)
@@ -255,11 +260,37 @@ def sample_hbm(tag: str = "sample") -> Optional[int]:
                 continue
             in_use = stats.get("bytes_in_use")
             if in_use is not None:
+                any_stats = True
                 gauge(f"hbm.device{i}.bytes_in_use", int(in_use))
                 gauge_max(f"hbm.device{i}.peak_bytes_in_use",
                           int(stats.get("peak_bytes_in_use", in_use)))
     except Exception:
-        pass                      # CPU/older backends: live_arrays only
+        pass
+    if not any_stats:
+        # allocator-stats fallback: estimate per-device occupancy from the
+        # live-array census so the gauges exist on every backend
+        try:
+            devs = jax.local_devices()
+            index = {d: i for i, d in enumerate(devs)}
+            per = [0] * len(devs)
+            for a in arrays:
+                try:
+                    placement = list(a.devices())
+                except Exception:
+                    continue
+                n = int(getattr(a, "nbytes", 0) or 0)
+                if not placement or not n:
+                    continue
+                share = n // len(placement)   # sharded: even split
+                for d in placement:
+                    i = index.get(d)
+                    if i is not None:
+                        per[i] += share
+            for i, v in enumerate(per):
+                gauge(f"hbm.device{i}.bytes_in_use", v)
+                gauge_max(f"hbm.device{i}.peak_bytes_in_use", v)
+        except Exception:
+            pass
     return live
 
 

@@ -62,7 +62,7 @@ STEADY_LONG = {"q19", "q65", "q_having"}
 # to the per-query trace file when SRJT_QB_TRACE_DIR is set)
 _METRIC_PREFIXES = ("join.engine.", "join.build_index.", "join.expand.",
                     "compiled.", "parquet.device_cols",
-                    "parquet.host_fallback_cols", "shuffle.")
+                    "parquet.host_fallback_cols", "shuffle.", "arena.")
 
 
 def _metrics_pick(counters: dict) -> dict:
@@ -240,6 +240,16 @@ def main():
                 hbm_peak = snap["gauges"].get("hbm.live_bytes.peak")
                 if hbm_peak is not None:
                     entry["hbm_peak_bytes"] = int(hbm_peak)
+                # HBM-arena accounting (present when SRJT_HBM_ARENA /
+                # SRJT_HBM_BUDGET enabled the subsystem for the run)
+                arena_peak = snap["gauges"].get("arena.peak_bytes")
+                if arena_peak is not None:
+                    entry["peak_arena_bytes"] = int(arena_peak)
+                spills = snap["counters"].get("arena.spill.events")
+                if spills:
+                    entry["spills"] = int(spills)
+                    entry["spill_bytes"] = int(
+                        snap["counters"].get("arena.spill.bytes", 0))
                 if trace_dir:
                     metrics.export_chrome_trace(
                         os.path.join(trace_dir, f"{name}.json"))
