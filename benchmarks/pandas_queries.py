@@ -480,6 +480,37 @@ def q34_baskets(dfs, qty_min=60):
             ["ss_item_sk"].count())
 
 
+def q_channel_day(dfs):
+    ss, ws, item = dfs["store_sales"], dfs["web_sales"], dfs["item"]
+    s_rev = (ss.groupby(["ss_item_sk", "ss_sold_date_sk"], as_index=False)
+             ["ss_ext_sales_price"].sum())
+    w_rev = (ws.groupby(["ws_item_sk", "ws_sold_date_sk"], as_index=False)
+             ["ws_ext_sales_price"].sum())
+    j = s_rev.merge(w_rev, left_on=["ss_item_sk", "ss_sold_date_sk"],
+                    right_on=["ws_item_sk", "ws_sold_date_sk"])
+    j = j.merge(item, left_on="ss_item_sk", right_on="i_item_sk")
+    return (j.groupby("i_category", as_index=False)
+            .agg(s=("ss_ext_sales_price", "sum"),
+                 w=("ws_ext_sales_price", "sum")))
+
+
+def q_web_also_qty(dfs):
+    ss, ws = dfs["store_sales"], dfs["web_sales"]
+    pairs = ws[["ws_item_sk", "ws_sold_date_sk"]].drop_duplicates()
+    j = ss.merge(pairs, left_on=["ss_item_sk", "ss_sold_date_sk"],
+                 right_on=["ws_item_sk", "ws_sold_date_sk"])
+    return (j.groupby("ss_store_sk", as_index=False)["ss_quantity"].sum())
+
+
+def q_brand_rev_left(dfs, manager_id=28):
+    ss, item = dfs["store_sales"], dfs["item"]
+    j = ss.merge(item[item.i_manager_id == manager_id],
+                 left_on="ss_item_sk", right_on="i_item_sk", how="left")
+    return (j.groupby("i_brand_id", dropna=False, as_index=False)
+            .agg(s=("ss_ext_sales_price", "sum"),
+                 c=("ss_item_sk", "count")))
+
+
 QUERIES = {
     "q3": q3, "q42": q42, "q52": q52, "q55": q55,
     "q_state_rollup": q_state_rollup, "q7": q7, "q19": q19, "q62": q62,
@@ -502,5 +533,6 @@ QUERIES = {
     "q_null_share": q_null_share,
     "q17_stats": q17_stats, "q8_intersect": q8_intersect,
     "q87_except": q87_except, "q_dense_rank_cat": q_dense_rank_cat,
-    "q34_baskets": q34_baskets,
+    "q34_baskets": q34_baskets, "q_channel_day": q_channel_day,
+    "q_web_also_qty": q_web_also_qty, "q_brand_rev_left": q_brand_rev_left,
 }

@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# Composite-key join smoke — the join-engine analog of ci/arena_smoke.sh:
+# run ONE multi-key TPC-DS query (q_channel_day: channels join on the
+# (item_sk, sold_date_sk) tuple) with metrics on, assert the exported
+# Chrome trace recorded at least one `join.pack.composite` count (the
+# tuple actually took the packed dense path), then re-run the same query
+# with SRJT_JOIN_ENGINE=sorted and assert bit-identical results.
+# Artifacts land in target/join_smoke/ for workflow upload.
+#
+# Usage: ci/join_smoke.sh [n_sales] [query]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N_SALES="${1:-200000}"
+QUERY="${2:-q_channel_day}"
+OUT=target/join_smoke
+mkdir -p "$OUT"
+
+echo "== join smoke: $QUERY over $N_SALES rows =="
+XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+SPARK_RAPIDS_TPU_METRICS=1 \
+SRJT_SMOKE_OUT="$OUT" SRJT_SMOKE_N="$N_SALES" SRJT_SMOKE_Q="$QUERY" \
+python - <<'PYEOF'
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+out = os.environ["SRJT_SMOKE_OUT"]
+n_sales = int(os.environ["SRJT_SMOKE_N"])
+qname = os.environ["SRJT_SMOKE_Q"]
+
+import numpy as np
+
+from benchmarks import tpcds_data
+from spark_rapids_jni_tpu.models import tpcds
+from spark_rapids_jni_tpu.ops import join_plan
+from spark_rapids_jni_tpu.utils import metrics
+
+files = tpcds_data.generate(n_sales=n_sales, n_items=2_000, n_stores=10,
+                            seed=5)
+tables = tpcds.load_tables(files)
+
+# planner-chosen run: the multi-key tuple must pack onto the composite path
+metrics.reset()
+with metrics.span(f"query:{qname}", n_sales=n_sales):
+    got = tpcds.QUERIES[qname](tables)
+print(f"{qname}: {got.num_rows} rows (planner engines)")
+
+trace_path = metrics.export_chrome_trace(os.path.join(out, "trace.json"))
+with open(os.path.join(out, "summary.json"), "w") as f:
+    json.dump(metrics.summary(), f, indent=1)
+
+with open(trace_path) as f:
+    doc = json.load(f)
+counters = doc["srjtCounters"]
+assert counters.get("join.pack.composite", 0) >= 1, counters
+names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+assert "join.pack" in names, sorted(names)
+print("composite packs:", counters["join.pack.composite"],
+      "| trace well-formed:", trace_path)
+
+# pinned sort-probe run over FRESH tables: every engine decision forced to
+# the sorted fallback — results must be bit-identical to the packed run
+join_plan._INDEX_CACHE.clear()
+join_plan._PLAN_CACHE.clear()
+os.environ["SRJT_JOIN_ENGINE"] = "sorted"
+expect = tpcds.QUERIES[qname](tables)
+assert got.num_rows == expect.num_rows, (got.num_rows, expect.num_rows)
+for i in range(len(expect.columns)):
+    a, b = expect[i], got[i]
+    if a.dtype.id.name == "STRING":
+        assert a.to_pylist() == b.to_pylist(), f"col {i} differs"
+    else:
+        np.testing.assert_array_equal(a.to_numpy(), b.to_numpy(),
+                                      err_msg=f"col {i}")
+print("composite result identical to forced-sorted run")
+PYEOF
+
+echo "join smoke OK"

@@ -287,14 +287,15 @@ def q65(tables: dict[str, Table], frac: float = 0.9) -> Table:
 
 def q_store_counts(tables: dict[str, Table]) -> Table:
     """Per-store sale counts INCLUDING stores with no sales (left join →
-    count over a nullable column; Spark's LEFT OUTER + COUNT semantics)."""
+    count over a nullable column; Spark's LEFT OUTER + COUNT semantics) —
+    the left-join→groupby tail runs fused through ``ops.join_aggregate``."""
     ss, store = tables["store_sales"], tables["store"]
-    j = left_join(store, ss, _col(STORE_COLS, "s_store_sk"),
-                  _col(SS_COLS, "ss_store_sk"))
     cols = STORE_COLS + SS_COLS
-    out = groupby_aggregate(
-        j, [cols.index("s_store_sk"), cols.index("s_state")],
-        [(cols.index("ss_item_sk"), "count")])
+    out = join_aggregate(
+        store, ss, _col(STORE_COLS, "s_store_sk"),
+        _col(SS_COLS, "ss_store_sk"),
+        [cols.index("s_store_sk"), cols.index("s_state")],
+        [(cols.index("ss_item_sk"), "count")], how="left")
     return sort_table(out, [0])
 
 
@@ -572,6 +573,67 @@ def q25_two_fact(tables: dict[str, Table], year: int = 2000) -> Table:
         [(WS_COLS.index("ws_ext_sales_price"), "sum")])
     j = inner_join(s_rev, w_rev, 0, 0)
     return sort_table(Table([j[0], j[1], j[3]]), [0])
+
+
+def q_channel_day(tables: dict[str, Table]) -> Table:
+    """Per-category store vs web revenue on (item, day) tuples sold in
+    BOTH channels — the Q72-style j1→j2 chain: each channel aggregates on
+    the (item_sk, sold_date_sk) tuple, the channels join on the 2-column
+    key (packed onto the composite dense path by ``join_plan.plan_keys``),
+    and the result chains into a fused join+aggregate against item."""
+    ss, ws, item = (tables["store_sales"], tables["web_sales"],
+                    tables["item"])
+    s_rev = groupby_aggregate(
+        ss, [_col(SS_COLS, "ss_item_sk"), _col(SS_COLS, "ss_sold_date_sk")],
+        [(_col(SS_COLS, "ss_ext_sales_price"), "sum")])
+    w_rev = groupby_aggregate(
+        ws, [_col(WS_COLS, "ws_item_sk"), _col(WS_COLS, "ws_sold_date_sk")],
+        [(_col(WS_COLS, "ws_ext_sales_price"), "sum")])
+    j1 = inner_join(s_rev, w_rev, [0, 1], [0, 1])   # 2-key tuple join
+    # j1 schema: [item, day, s_sum] ++ [item, day, w_sum]
+    work = Table([j1[0], j1[2], j1[5]])
+    cols = ["item_sk", "s_sum", "w_sum"] + ITEM_COLS
+    out = join_aggregate(
+        work, item, 0, _col(ITEM_COLS, "i_item_sk"),
+        [cols.index("i_category")],
+        [(cols.index("s_sum"), "sum"), (cols.index("w_sum"), "sum")])
+    return sort_table(out, [0])
+
+
+def q_web_also_qty(tables: dict[str, Table]) -> Table:
+    """Store quantity per store restricted to (item, day) tuples that ALSO
+    sold on the web — a 2-key composite join whose fused weighted-groupby
+    tail never materializes the pairs (the build side is the distinct
+    tuple set, so each probe row matches at most once)."""
+    ss, ws = tables["store_sales"], tables["web_sales"]
+    pairs = distinct(Table([ws[_col(WS_COLS, "ws_item_sk")],
+                            ws[_col(WS_COLS, "ws_sold_date_sk")]]))
+    cols = SS_COLS + ["wi_item_sk", "wd_date_sk"]
+    out = join_aggregate(
+        ss, pairs,
+        [_col(SS_COLS, "ss_item_sk"), _col(SS_COLS, "ss_sold_date_sk")],
+        [0, 1],
+        [cols.index("ss_store_sk")], [(cols.index("ss_quantity"), "sum")])
+    return sort_table(out, [0])
+
+
+def q_brand_rev_left(tables: dict[str, Table], manager_id: int = 28) -> Table:
+    """Revenue per brand for one manager's items, KEEPING sales of every
+    other item as the null-brand group (LEFT OUTER → GROUP BY — Q55's
+    left-outer twin) — runs fused through ``ops.join_aggregate`` with
+    ``how="left"``: the unique filtered build side means no pair expansion
+    and no compaction, unmatched rows just null their brand."""
+    ss, item = tables["store_sales"], tables["item"]
+    item_f = apply_boolean_mask(
+        item, _eq_scalar_mask(item[_col(ITEM_COLS, "i_manager_id")],
+                              manager_id))
+    cols = SS_COLS + ITEM_COLS
+    out = join_aggregate(ss, item_f, _col(SS_COLS, "ss_item_sk"),
+                         _col(ITEM_COLS, "i_item_sk"),
+                         [cols.index("i_brand_id")],
+                         [(cols.index("ss_ext_sales_price"), "sum"),
+                          (cols.index("ss_item_sk"), "count")], how="left")
+    return sort_table(out, [0])
 
 
 def q88_counts(tables: dict[str, Table]) -> Table:
@@ -912,12 +974,16 @@ QUERIES = {"q3": q3, "q42": q42, "q52": q52, "q55": q55,
            # round-5 breadth
            "q17_stats": q17_stats, "q8_intersect": q8_intersect,
            "q87_except": q87_except, "q_dense_rank_cat": q_dense_rank_cat,
-           "q34_baskets": q34_baskets}
+           "q34_baskets": q34_baskets,
+           # round-6: composite multi-key joins + left-outer fusion
+           "q_channel_day": q_channel_day, "q_web_also_qty": q_web_also_qty,
+           "q_brand_rev_left": q_brand_rev_left}
 
 # queries that read the second fact table (skipped when absent)
 _NEEDS_WEB = {"q_union_channels", "q5_grouping_sets", "q78_outer",
               "q25_two_fact", "q_cross_ratio", "q_null_share",
-              "q8_intersect", "q87_except"}
+              "q8_intersect", "q87_except", "q_channel_day",
+              "q_web_also_qty"}
 
 
 def run_all(files: dict[str, bytes]) -> dict[str, Table]:

@@ -86,6 +86,32 @@ def murmur3_32(values: jnp.ndarray,
     return _fmix(h ^ length)
 
 
+# second-chain seed for 64-bit fingerprints: an arbitrary constant far from
+# Spark's 42 so the two 32-bit chains decorrelate
+_FP_SEED_HI = np.uint32(0x9E3779B9)
+
+
+def fingerprint64(lanes) -> jnp.ndarray:
+    """Order-sensitive 64-bit fingerprint of a key tuple → int64 [n].
+
+    Two independent murmur3 chains in Spark's multi-column shape (each
+    column's hash seeds the next — ``murmur3_32`` broadcasts array seeds)
+    with distinct initial seeds form the low and high words.  Collisions
+    are possible: callers MUST verify true lane equality on candidate
+    pairs (``ops.join`` does) — the fingerprint is a probe lane, not an
+    equality proof.
+    """
+    if not lanes:
+        raise ValueError("fingerprint64: at least one key lane required")
+    lo = hi = None
+    for lane in lanes:
+        lo = murmur3_32(lane, DEFAULT_SEED if lo is None else lo)
+        hi = murmur3_32(lane, _FP_SEED_HI if hi is None else hi)
+    u = lo.astype(jnp.uint64) | (hi.astype(jnp.uint64) << np.uint64(32))
+    # reinterpret as int64: the join engines' key dtype, bit pattern kept
+    return jax.lax.bitcast_convert_type(u, jnp.int64)
+
+
 def hash_partition(hashes: jnp.ndarray, num_partitions: int) -> jnp.ndarray:
     """Spark-style non-negative modulo partitioning → int32 [n] in [0, P)."""
     m = (hashes.astype(jnp.int32) % np.int32(num_partitions)).astype(jnp.int32)

@@ -17,14 +17,17 @@ total resolved with one scalar sync per the two-phase discipline — is
 shared, and the engines produce bit-identical join indices.  Build-side
 indexes are cached on column-buffer identity (``join_plan.build_index``).
 
-Join keys: any fixed-width column.  Null keys never match (Spark equi-join
-semantics).  Multi-key joins pack via ``ops.hashing`` + verification gather,
-or pre-pack two int32 keys into one int64.
+Join keys: any fixed-width column, or a LIST of key columns (multi-column
+equi-join — tuple equality, a null in ANY key column never matches).
+Multi-column keys are planned by ``join_plan.plan_keys``: dense-eligible
+tuples range-compress into one int64 composite riding the single-key
+engines unchanged; everything else probes on a 64-bit fingerprint and this
+module verifies true lane equality on the candidate pairs.
 """
 
 from __future__ import annotations
 
-from typing import Literal
+from typing import Literal, Sequence, Union
 
 import jax.numpy as jnp
 import numpy as np
@@ -34,6 +37,9 @@ from ..memory import arena
 from ..memory.budget import PAIR_EXPANSION_BYTES
 from ..utils import metrics, syncs
 from .filter import gather
+
+JoinKey = Union[Column, Sequence[Column]]
+OnKey = Union[int, Sequence[int]]
 
 
 def _key_with_nulls_last(col: Column):
@@ -52,34 +58,39 @@ def _key_with_nulls_last(col: Column):
     return data, col.validity
 
 
-def join_indices(left: Column, right: Column,
+def _as_key_cols(key) -> list:
+    return list(key) if isinstance(key, (list, tuple)) else [key]
+
+
+def join_indices(left: JoinKey, right: JoinKey,
                  how: Literal["inner", "left", "semi", "anti"] = "inner"):
     """Compute (left_idx, right_idx) gather maps for an equi-join.
 
-    ``semi``/``anti`` return only left_idx.  ``left`` outer marks unmatched
-    rows with right_idx == -1 (callers null-fill on gather).
+    Each side takes one key Column or an equal-length list of key columns
+    (multi-column equi-join).  ``semi``/``anti`` return only left_idx.
+    ``left`` outer marks unmatched rows with right_idx == -1 (callers
+    null-fill on gather).
     """
     with metrics.span("join.indices", how=how):
-        return _join_indices(left, right, how)
+        return _join_indices(_as_key_cols(left), _as_key_cols(right), how)
 
 
-def _join_indices(left: Column, right: Column, how: str):
-    if left.dtype.is_variable_width or right.dtype.is_variable_width:
-        # string keys: one shared dictionary makes code equality == string
-        # equality across both sides (ops.strings)
-        from . import strings
-        left, right = strings.encode_shared([left, right])
+def _join_indices(lcols: list, rcols: list, how: str):
     from . import join_plan
-    ldata, lvalid = _key_with_nulls_last(left)
-    rdata, rvalid = _key_with_nulls_last(right)
 
-    # index the build (right) side — planner-selected layout, memoized on
-    # the key buffers' identity; null build keys are dropped outright
-    dense_ok = (join_plan.dense_eligible(right)
-                and join_plan.dense_eligible(left))
-    ix = join_plan.build_index(rdata, rvalid, dense_ok)
-    lo, counts = join_plan.probe_counts(ix, ldata, lvalid)
+    # plan the probe lanes (string encode / composite pack / fingerprint),
+    # then index the build (right) side — planner-selected layout, memoized
+    # on the key buffers' identity; null build keys are dropped outright
+    plan = join_plan.plan_keys(lcols, rcols)
+    ix = join_plan.build_index(plan.rdata, plan.rvalid, plan.dense_ok)
+    lo, counts = join_plan.probe_counts(ix, plan.ldata, plan.lvalid)
     nr = ix.row_ids.shape[0]
+
+    if plan.verify:
+        # hashed probe lane: counts are CANDIDATE counts — every output
+        # below must reject fingerprint collisions first
+        return _verified_join(plan, ix, lo, counts, how)
+    ldata, lvalid = plan.ldata, plan.lvalid
 
     if how in ("semi", "anti"):
         # two-phase like every dynamic size (count sync → sized nonzero) so
@@ -104,8 +115,13 @@ def _join_indices(left: Column, right: Column, how: str):
         return left_idx, right_idx
 
     if how == "left":
+        # match count needs its own sync here (total below includes the
+        # unmatched keep-one rows); unconditional so capture/replay tapes
+        # never depend on metrics state
+        matched_rows = syncs.scalar(jnp.sum(counts))
         out_counts = jnp.maximum(counts, 1)   # unmatched keep one row
     else:
+        matched_rows = None
         out_counts = counts
 
     total = syncs.scalar(jnp.sum(out_counts))     # scalar sync (pair count)
@@ -114,6 +130,8 @@ def _join_indices(left: Column, right: Column, how: str):
         # is the HBM-arena pressure point — ROADMAP open item
         metrics.count("join.expand.calls")
         metrics.observe("join.expand.pair_elements", total)
+        metrics.observe("join.match_rows",
+                        total if matched_rows is None else matched_rows)
         metrics.annotate(expand_pairs=total)
     # admission-control the ephemeral expansion working set (the int64
     # lanes + mask below) before XLA materializes it; under pressure this
@@ -135,9 +153,79 @@ def _join_indices(left: Column, right: Column, how: str):
         return left_idx, right_idx
 
 
-def inner_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:
-    """Inner equi-join; result columns = left columns ++ right columns."""
-    li, ri = join_indices(left[left_on], right[right_on], "inner")
+def _pair_candidates(ix, lo, counts):
+    """Aligned (probe_row, build_row) candidate pairs from probe results —
+    the shared inner-pair enumeration: unique-build rows come straight off
+    the scatter LUT, everything else runs the arena-admitted searchsorted
+    expansion."""
+    nr = ix.row_ids.shape[0]
+    total = syncs.scalar(jnp.sum(counts))         # scalar sync (pair count)
+    if nr == 0 or total == 0:
+        z = jnp.zeros(0, jnp.int64)
+        return z, z
+    if ix.unique:
+        left_idx = jnp.nonzero(counts > 0, size=total)[0]
+        right_idx = ix.row_ids[jnp.minimum(lo, nr - 1)[left_idx]]
+        return left_idx, right_idx
+    if metrics.recording():
+        metrics.count("join.expand.calls")
+        metrics.observe("join.expand.pair_elements", total)
+    with arena.reserve(total * PAIR_EXPANSION_BYTES, tag="join.expand"):
+        starts = (jnp.cumsum(counts) - counts).astype(jnp.int64)
+        pair_ids = jnp.arange(total, dtype=jnp.int64)
+        left_idx = jnp.searchsorted(starts, pair_ids, side="right") - 1
+        within = pair_ids - starts[left_idx]
+        r_pos = lo[left_idx].astype(jnp.int64) + within
+        right_idx = ix.row_ids[jnp.minimum(r_pos, nr - 1)]
+        return left_idx, right_idx
+
+
+def _verified_join(plan, ix, lo, counts, how: str):
+    """Fingerprint/fallback tail: enumerate candidate pairs on the hashed
+    probe lane, then keep only pairs where EVERY true key lane matches —
+    fingerprint collisions are rejected before any output is built."""
+    li, ri = _pair_candidates(ix, lo, counts)
+    eq = jnp.ones(li.shape[0], jnp.bool_)
+    for ll, rl in plan.verify:
+        eq = eq & (ll[li] == rl[ri])
+    kept = syncs.scalar(jnp.sum(eq))         # scalar sync (verified pairs)
+    if metrics.recording():
+        metrics.count("join.verify.candidates", int(li.shape[0]))
+        metrics.count("join.verify.collisions", int(li.shape[0]) - kept)
+        if how in ("inner", "left"):
+            metrics.observe("join.match_rows", kept)
+    sel = jnp.nonzero(eq, size=kept)[0]
+    li, ri = li[sel], ri[sel]
+    if how == "inner":
+        return li, ri
+    n = plan.ldata.shape[0]
+    has = jnp.zeros(n, jnp.bool_).at[li].set(True)
+    if how in ("semi", "anti"):
+        m = has if how == "semi" else ~has
+        k = syncs.scalar(jnp.sum(m))
+        return jnp.nonzero(m, size=k)[0]
+    # left outer: verified pairs plus one null-extended row per unmatched
+    # probe row, restored to probe-row-major order (the expansion tail's
+    # output order) by a stable sort on the left index
+    miss = ~has
+    nm = syncs.scalar(jnp.sum(miss))
+    mi = jnp.nonzero(miss, size=nm)[0]
+    left_idx = jnp.concatenate([li, mi])
+    right_idx = jnp.concatenate([ri, jnp.full(nm, -1, jnp.int64)])
+    order = jnp.argsort(left_idx, stable=True)
+    return left_idx[order], right_idx[order]
+
+
+def _key_of(t: Table, on: OnKey):
+    return [t[i] for i in on] if isinstance(on, (list, tuple)) else t[on]
+
+
+def inner_join(left: Table, right: Table, left_on: OnKey,
+               right_on: OnKey) -> Table:
+    """Inner equi-join; result columns = left columns ++ right columns.
+    ``left_on``/``right_on``: one column index or equal-length lists."""
+    li, ri = join_indices(_key_of(left, left_on), _key_of(right, right_on),
+                          "inner")
     lt = gather(left, li)
     rt = gather(right, ri)
     return Table(list(lt.columns) + list(rt.columns))
@@ -180,9 +268,11 @@ def _null_column(dt, n: int) -> Column:
     return Column(dt, arena.zeros(n, dt.storage), validity=nulls)
 
 
-def left_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:
+def left_join(left: Table, right: Table, left_on: OnKey,
+              right_on: OnKey) -> Table:
     """Left outer equi-join; unmatched right columns become null."""
-    li, ri = join_indices(left[left_on], right[right_on], "left")
+    li, ri = join_indices(_key_of(left, left_on), _key_of(right, right_on),
+                          "left")
     lt = gather(left, li)
     if right.num_rows == 0:   # nothing to gather — all-null right columns
         right_cols = [_null_column(c.dtype, int(li.shape[0]))
@@ -205,7 +295,8 @@ def left_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:
     return Table(list(lt.columns) + [_with_matched(c) for c in rt.columns])
 
 
-def right_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:
+def right_join(left: Table, right: Table, left_on: OnKey,
+               right_on: OnKey) -> Table:
     """Right outer equi-join; result columns = left ++ right, with null
     left columns on unmatched right rows."""
     mirrored = left_join(right, left, right_on, left_on)
@@ -213,8 +304,8 @@ def right_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:
     return Table(cols[right.num_columns:] + cols[:right.num_columns])
 
 
-def full_outer_join(left: Table, right: Table, left_on: int,
-                    right_on: int) -> Table:
+def full_outer_join(left: Table, right: Table, left_on: OnKey,
+                    right_on: OnKey) -> Table:
     """Full outer equi-join: left-join pairs plus unmatched right rows with
     null left columns (Spark FULL OUTER)."""
     from .copying import concat_tables
@@ -226,9 +317,13 @@ def full_outer_join(left: Table, right: Table, left_on: int,
     return concat_tables([lj, Table(null_left + list(extra.columns))])
 
 
-def semi_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:
-    return gather(left, join_indices(left[left_on], right[right_on], "semi"))
+def semi_join(left: Table, right: Table, left_on: OnKey,
+              right_on: OnKey) -> Table:
+    return gather(left, join_indices(_key_of(left, left_on),
+                                     _key_of(right, right_on), "semi"))
 
 
-def anti_join(left: Table, right: Table, left_on: int, right_on: int) -> Table:
-    return gather(left, join_indices(left[left_on], right[right_on], "anti"))
+def anti_join(left: Table, right: Table, left_on: OnKey,
+              right_on: OnKey) -> Table:
+    return gather(left, join_indices(_key_of(left, left_on),
+                                     _key_of(right, right_on), "anti"))

@@ -335,6 +335,218 @@ def probe_counts(ix: BuildIndex, ldata, lvalid):
     return lo, counts
 
 
+# --- multi-column key packing ------------------------------------------------
+
+
+COMPOSITE_BITS = 63     # packed tuples must index as a non-negative int64
+
+
+class KeyPlan(NamedTuple):
+    """Physical probe plan for one (possibly multi-column) equi-join key.
+
+    ``ldata``/``rdata`` are the single fixed-width lanes the engines
+    consume; ``verify`` carries ``(left_lane, right_lane)`` pairs that
+    candidate matches must additionally satisfy — empty when the probe
+    lane alone encodes tuple equality exactly (single keys, composites)."""
+    mode: str            # "single" | "composite" | "fingerprint" | "fallback"
+    ldata: jnp.ndarray
+    lvalid: Optional[jnp.ndarray]
+    rdata: jnp.ndarray
+    rvalid: Optional[jnp.ndarray]
+    verify: tuple
+    dense_ok: bool
+
+
+def _and_valid(a, b):
+    if a is None:
+        return b
+    return a if b is None else (a & b)
+
+
+def _key_lanes(col: Column):
+    """Fixed-width equality lanes for one (already string-encoded) key
+    column: one int-kind lane for everything the single-key path probes,
+    two int64 limb lanes for decimal128."""
+    from .join import _key_with_nulls_last
+    c = force_column(col)
+    if c.dtype.id == T.TypeId.DECIMAL128:
+        return [c.data[:, 0], c.data[:, 1]], c.validity
+    data, valid = _key_with_nulls_last(c)
+    return [data], valid
+
+
+class _PlanCache:
+    """Tiny LRU memo for multi-key pack plans, keyed on the key columns'
+    device-buffer identity.  Without it every repeated multi-key probe
+    would re-pack into FRESH composite arrays and the build-index cache
+    (also identity-keyed) could never hit; with it the second probe of the
+    same key buffers returns the same ``KeyPlan`` object and the index
+    cache sees the same ``rdata`` buffer.  Bypassed under capture/replay
+    for the same reason the index cache is: a memo hit would skip the
+    window ``syncs.scalar`` calls and misalign the tape."""
+
+    def __init__(self, cap: int = 8):
+        self._d: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._cap = cap
+
+    def get(self, key, arrays) -> Optional["KeyPlan"]:
+        if syncs.mode() != "normal":
+            return None
+        e = self._d.get(key)
+        if e is None:
+            return None
+        for r, a in zip(e["refs"], arrays):
+            if r() is not a:
+                return None
+        self._d.move_to_end(key)
+        return e["plan"]
+
+    def put(self, key, arrays, plan: "KeyPlan") -> None:
+        if syncs.mode() != "normal":
+            return
+        try:
+            refs = tuple(
+                weakref.ref(a, lambda _, k=key: self._d.pop(k, None))
+                for a in arrays)
+        except TypeError:
+            return
+        self._d[key] = {"refs": refs, "plan": plan}
+        while len(self._d) > self._cap:
+            self._d.popitem(last=False)
+
+    def clear(self) -> None:
+        self._d.clear()
+
+
+_PLAN_CACHE = _PlanCache()
+
+
+def plan_keys(left_cols: Sequence[Column],
+              right_cols: Sequence[Column]) -> KeyPlan:
+    """Plan the physical probe lanes for a k-column equi-join key.
+
+    Single keys pass through untouched (join engine v2 behavior).  String
+    columns are dictionary-encoded first against one shared dictionary
+    (``strings.encode_shared`` — code equality == string equality), then
+    multi-column tuples pack one of three ways:
+
+    * **composite** — every column is :func:`dense_eligible` and the
+      product of the build-side windows ``[kmin_i, kmin_i + span_i)`` fits
+      in 63 bits: the tuple packs into one non-negative int64
+      (mixed-radix over the windows), probe rows falling outside any build
+      window are invalidated, and composite equality == tuple equality —
+      so the dense LUT, build-index cache, arena admission and
+      capture/replay machinery all apply to multi-key joins unchanged.
+    * **fingerprint** — the windows overflow 63 bits: probe on a 64-bit
+      murmur3 fingerprint of the tuple (``ops.hashing.fingerprint64``) and
+      let ``ops.join`` verify true lane equality on the candidate pairs.
+    * **fallback** — some column can never pack exactly (f64 bit-keys,
+      decimal128 limbs, uint64): same hashed probe + verification, counted
+      separately so traces show the tuple never qualified for packing.
+    """
+    from . import strings
+    k = len(left_cols)
+    if k != len(right_cols):
+        raise ValueError("join keys: left/right lists differ in length")
+    if k == 0:
+        raise ValueError("join keys: at least one key column required")
+    enc_l, enc_r = [], []
+    for lc, rc in zip(left_cols, right_cols):
+        if lc.dtype.is_variable_width or rc.dtype.is_variable_width:
+            lc, rc = strings.encode_shared([lc, rc])
+        enc_l.append(lc)
+        enc_r.append(rc)
+    if k == 1:
+        from .join import _key_with_nulls_last
+        lc, rc = enc_l[0], enc_r[0]
+        ldata, lvalid = _key_with_nulls_last(force_column(lc))
+        rdata, rvalid = _key_with_nulls_last(force_column(rc))
+        return KeyPlan("single", ldata, lvalid, rdata, rvalid, (),
+                       dense_eligible(rc) and dense_eligible(lc))
+    with metrics.span("join.pack", n_keys=k):
+        enc_l = [force_column(c) for c in enc_l]
+        enc_r = [force_column(c) for c in enc_r]
+        arrays = [a for c in enc_l + enc_r
+                  for a in (c.data, c.validity) if a is not None]
+        ck = tuple(id(a) for a in arrays)
+        hit = _PLAN_CACHE.get(ck, arrays)
+        if hit is not None:
+            metrics.count("join.pack.cache_hit")
+            if metrics.recording():
+                metrics.annotate(mode=hit.mode, cached=True)
+            return hit
+        plan = _pack_keys(enc_l, enc_r)
+        _PLAN_CACHE.put(ck, arrays, plan)
+        return plan
+
+
+def _pack_keys(lcols, rcols) -> KeyPlan:
+    from .hashing import fingerprint64
+
+    llanes, rlanes = [], []
+    lvalid = rvalid = None
+    packable = True
+    for lc, rc in zip(lcols, rcols):
+        ll, lv = _key_lanes(lc)
+        rl, rv = _key_lanes(rc)
+        llanes += ll
+        rlanes += rl
+        lvalid = _and_valid(lvalid, lv)
+        rvalid = _and_valid(rvalid, rv)
+        packable = packable and dense_eligible(lc) and dense_eligible(rc)
+    if packable:
+        # build-side window per column — unconditional scalar syncs (the
+        # capture/replay tape must not depend on metrics state); an
+        # all-null build column degenerates to a span-1 window nothing on
+        # the probe side can enter, which is exactly "null never matches"
+        windows = []
+        prod = 1
+        for rl in rlanes:
+            if rl.shape[0] == 0:
+                windows.append((0, 1))
+                continue
+            info = np.iinfo(np.dtype(rl.dtype))
+            vmin = rl if rvalid is None else jnp.where(rvalid, rl, info.max)
+            vmax = rl if rvalid is None else jnp.where(rvalid, rl, info.min)
+            kmin = syncs.scalar(jnp.min(vmin))
+            span = max(syncs.scalar(jnp.max(vmax)) - kmin + 1, 1)
+            windows.append((kmin, span))
+            prod *= span
+        if prod < (1 << COMPOSITE_BITS):
+            # mixed-radix pack, last key fastest; per-lane clip keeps the
+            # accumulator in [0, prod) so int64 arithmetic never wraps
+            comp_l = jnp.zeros(llanes[0].shape[0], jnp.int64)
+            comp_r = jnp.zeros(rlanes[0].shape[0], jnp.int64)
+            in_win = None
+            stride = 1
+            for (kmin, span), ll, rl in zip(windows[::-1], llanes[::-1],
+                                            rlanes[::-1]):
+                dl = ll.astype(jnp.int64) - kmin
+                ok = (dl >= 0) & (dl < span)
+                in_win = ok if in_win is None else (in_win & ok)
+                comp_l = comp_l + jnp.clip(dl, 0, span - 1) * stride
+                dr = jnp.clip(rl.astype(jnp.int64) - kmin, 0, span - 1)
+                comp_r = comp_r + dr * stride
+                stride *= span
+            # probe tuples outside any build window cannot match — fold
+            # the window test into key validity (the engines' null mask)
+            lvalid = _and_valid(lvalid, in_win)
+            metrics.count("join.pack.composite")
+            if metrics.recording():
+                metrics.annotate(mode="composite", span_product=prod)
+            return KeyPlan("composite", comp_l, lvalid, comp_r, rvalid,
+                           (), True)
+        mode = "fingerprint"
+    else:
+        mode = "fallback"
+    metrics.count(f"join.pack.{mode}")
+    if metrics.recording():
+        metrics.annotate(mode=mode)
+    verify = tuple(zip(llanes, rlanes))
+    return KeyPlan(mode, fingerprint64(llanes), lvalid,
+                   fingerprint64(rlanes), rvalid, verify, False)
+
+
 # --- join→aggregate fusion ---------------------------------------------------
 
 
@@ -343,52 +555,88 @@ def _take_col(col: Column, idx) -> Column:
     return _gather_column(force_column(col), idx)
 
 
-def join_aggregate(left: Table, right: Table, left_on: int, right_on: int,
-                   group_keys: Sequence[int],
-                   aggs: Sequence[tuple[int, str]]) -> Table:
-    """``groupby_aggregate(inner_join(left, right, left_on, right_on),
-    group_keys, aggs)`` without materializing the join pairs.
+def _null_where(col: Column, keep) -> Column:
+    """Gathered build column with validity additionally masked by ``keep``
+    — the eager twin of ``ops.join.left_join``'s deferred ``_with_matched``
+    (bit-identical null pattern)."""
+    g = force_column(col)
+    v = keep if g.validity is None else (g.validity & keep)
+    return Column(g.dtype, g.data, g.offsets, v, g.children)
 
-    ``group_keys`` and the agg value indices address the joined
-    (left ++ right) schema.  Fused shapes:
+
+def join_aggregate(left: Table, right: Table, left_on, right_on,
+                   group_keys: Sequence[int],
+                   aggs: Sequence[tuple[int, str]],
+                   how: str = "inner") -> Table:
+    """``groupby_aggregate(join(left, right, left_on, right_on), group_keys,
+    aggs)`` without materializing the join pairs, for ``how`` in
+    ``("inner", "left")``.
+
+    ``left_on``/``right_on`` take a single column index or equal-length
+    index lists (multi-column keys route through :func:`plan_keys` like
+    ``ops.join``).  ``group_keys`` and the agg value indices address the
+    joined (left ++ right) schema.  Fused shapes:
 
     * **unique build side** (the TPC-DS star shape — fact ⋈ dimension on a
       surrogate PK): matched probe rows ARE the joined rows, so only the
       group-key/value columns are gathered (one compaction sync) and fed
       straight into ``ops.groupby``'s segment reductions — no pair
-      expansion, no wide joined table.
+      expansion, no wide joined table.  LEFT OUTER skips even the
+      compaction: every probe row is a joined row, left columns pass
+      through untouched and build columns null out where unmatched.
     * **probe-side-only columns** over a duplicated build side: each probe
       row's match count becomes a weight (sum/count/mean weight their
       contributions; min/max ignore multiplicity), so the pairs still
-      never materialize.
+      never materialize.  LEFT OUTER keeps unmatched rows at weight 1 —
+      their single null-extended joined row.
 
-    Anything else falls back to the materialized join + groupby (identical
-    result either way — differentially tested in tests/test_join_v2.py).
+    Anything else — including fingerprint-probed multi-key tuples, whose
+    candidate counts are not true match counts — falls back to the
+    materialized join + groupby (identical result either way —
+    differentially tested in tests/test_join_v2.py).
     """
-    from . import strings
     from .groupby import groupby_aggregate
-    from .join import _key_with_nulls_last, inner_join
+    from .join import inner_join, left_join
 
+    if how not in ("inner", "left"):
+        raise ValueError(f"join_aggregate: unsupported how={how!r}")
     nl = left.num_columns
-    lcol, rcol = left[left_on], right[right_on]
-    if lcol.dtype.is_variable_width or rcol.dtype.is_variable_width:
-        lcol, rcol = strings.encode_shared([lcol, rcol])
-    ldata, lvalid = _key_with_nulls_last(lcol)
-    rdata, rvalid = _key_with_nulls_last(rcol)
-    dense_ok = dense_eligible(rcol) and dense_eligible(lcol)
-    ix = build_index(rdata, rvalid, dense_ok)
-
+    lon = list(left_on) if isinstance(left_on, (list, tuple)) else [left_on]
+    ron = list(right_on) if isinstance(right_on, (list, tuple)) \
+        else [right_on]
+    plan = plan_keys([left[i] for i in lon], [right[i] for i in ron])
     needed = list(group_keys) + [vi for vi, _ in aggs]
+
+    def _unfused():
+        j = (inner_join if how == "inner" else left_join)(
+            left, right, left_on, right_on)
+        return groupby_aggregate(j, list(group_keys), list(aggs))
+
+    if plan.verify:
+        metrics.count("join.fused.fallback_join")
+        with metrics.span("join.aggregate", path="fallback_join"):
+            return _unfused()
+
+    ix = build_index(plan.rdata, plan.rvalid, plan.dense_ok)
     if ix.unique:
         metrics.count("join.fused.unique_gather")
         with metrics.span("join.aggregate", path="unique_gather"):
-            lo, counts = probe_counts(ix, ldata, lvalid)
-            m = counts > 0
-            k = syncs.scalar(jnp.sum(m))
-            li = jnp.nonzero(m, size=k)[0]
-            ri = ix.row_ids[jnp.minimum(lo[li], max(ix.n_valid - 1, 0))]
-            cols = [_take_col(left[ci], li) if ci < nl
-                    else _take_col(right[ci - nl], ri) for ci in needed]
+            lo, counts = probe_counts(ix, plan.ldata, plan.lvalid)
+            pos = jnp.minimum(lo, max(ix.n_valid - 1, 0))
+            if how == "inner":
+                m = counts > 0
+                k = syncs.scalar(jnp.sum(m))
+                li = jnp.nonzero(m, size=k)[0]
+                ri = ix.row_ids[pos[li]]
+                cols = [_take_col(left[ci], li) if ci < nl
+                        else _take_col(right[ci - nl], ri) for ci in needed]
+            else:
+                matched = counts > 0
+                ri = jnp.where(matched, ix.row_ids[pos], 0)
+                cols = [force_column(left[ci]) if ci < nl
+                        else _null_where(_take_col(right[ci - nl], ri),
+                                         matched)
+                        for ci in needed]
             nk = len(group_keys)
             return groupby_aggregate(
                 Table(cols), list(range(nk)),
@@ -399,19 +647,23 @@ def join_aggregate(left: Table, right: Table, left_on: int, right_on: int,
                              [(left[vi], agg) for vi, agg in aggs])):
         metrics.count("join.fused.weighted_groupby")
         with metrics.span("join.aggregate", path="weighted_groupby"):
-            lo, counts = probe_counts(ix, ldata, lvalid)
-            m = counts > 0
-            k = syncs.scalar(jnp.sum(m))
-            li = jnp.nonzero(m, size=k)[0]
-            w = counts.astype(jnp.int64)[li]
+            lo, counts = probe_counts(ix, plan.ldata, plan.lvalid)
+            if how == "inner":
+                m = counts > 0
+                k = syncs.scalar(jnp.sum(m))
+                li = jnp.nonzero(m, size=k)[0]
+                w = counts.astype(jnp.int64)[li]
+                return _weighted_groupby(
+                    [_take_col(left[ci], li) for ci in group_keys],
+                    [(_take_col(left[vi], li), agg) for vi, agg in aggs], w)
+            w = jnp.maximum(counts, 1).astype(jnp.int64)
             return _weighted_groupby(
-                [_take_col(left[ci], li) for ci in group_keys],
-                [(_take_col(left[vi], li), agg) for vi, agg in aggs], w)
+                [force_column(left[ci]) for ci in group_keys],
+                [(force_column(left[vi]), agg) for vi, agg in aggs], w)
 
     metrics.count("join.fused.fallback_join")
     with metrics.span("join.aggregate", path="fallback_join"):
-        j = inner_join(left, right, left_on, right_on)
-        return groupby_aggregate(j, list(group_keys), list(aggs))
+        return _unfused()
 
 
 def _weighted_ok(key_cols, val_aggs) -> bool:

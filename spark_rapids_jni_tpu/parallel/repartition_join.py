@@ -68,8 +68,8 @@ class JoinAggSpec(NamedTuple):
     (dense int32 codes in [0, num_groups) — callers dictionary-encode)."""
     fact_schema: tuple
     build_schema: tuple
-    fact_key_idx: int
-    build_key_idx: int
+    fact_key_idx: "int | tuple"
+    build_key_idx: "int | tuple"
     build_group_idx: int
     fact_value_idx: int
     num_groups: int
@@ -80,6 +80,42 @@ class JoinAggSpec(NamedTuple):
     # sorting + searchsorted.  0 (the default) keeps the sort-merge probe.
     key_min: int = 0
     key_span: int = 0
+    # composite multi-column keys (join engine v2 key packing): when the
+    # ``*_key_idx`` fields are equal-length tuples, each side's shuffle and
+    # probe lane is the mixed-radix int64 pack of its key tuple over these
+    # per-key build windows ``[key_mins[i], key_mins[i] + key_spans[i])``
+    # — 0-based, so a dense composite runs with key_min = 0 and
+    # key_span = prod(key_spans).  Rows with any null or out-of-window key
+    # never match (tuple-null semantics, same as ops/join_plan.py).
+    key_mins: tuple = ()
+    key_spans: tuple = ()
+
+
+def _composite_lane(datas, validm, idxs, mins, spans):
+    """Mixed-radix int64 pack of a key tuple (last key fastest) plus the
+    combined "all keys valid and in-window" mask — the shard-side twin of
+    ``ops/join_plan.py``'s composite pack (identical lane values, so the
+    shuffle routing and the local probe agree across chips)."""
+    comp = ok = None
+    stride = 1
+    for i, kmin, span in zip(idxs[::-1], mins[::-1], spans[::-1]):
+        d = datas[i].astype(jnp.int64) - kmin
+        okk = validm[:, i] & (d >= 0) & (d < span)
+        ok = okk if ok is None else (ok & okk)
+        t = jnp.clip(d, 0, span - 1) * stride
+        comp = t if comp is None else comp + t
+        stride *= span
+    return comp, ok
+
+
+def _key_lane(spec: JoinAggSpec, key_idx, datas, validm, mask):
+    """(probe lane, live mask) for one side's received rows: the raw key
+    column for single keys, the composite pack for tuple keys."""
+    if isinstance(key_idx, tuple):
+        lane, ok = _composite_lane(datas, validm, key_idx,
+                                   spec.key_mins, spec.key_spans)
+        return lane, mask & ok
+    return datas[key_idx], mask & validm[:, key_idx]
 
 
 def _shuffle_side(layout, datas, valid, key, axis_name, capacity, P):
@@ -102,12 +138,22 @@ def _local_join_agg(spec: JoinAggSpec, axis_name, num_partitions,
     lf = compute_row_layout(list(spec.fact_schema))
     lb = compute_row_layout(list(spec.build_schema))
 
+    # shuffle routing hashes the same lane the local probe uses — for
+    # composite keys both sides pack with the SAME static windows, so all
+    # rows of a tuple land on one chip
+    fshuf, _ = _key_lane(spec, spec.fact_key_idx, fact_datas, fact_valid,
+                         jnp.bool_(True))
+    bshuf, _ = _key_lane(spec, spec.build_key_idx, build_datas, build_valid,
+                         jnp.bool_(True))
     fdatas, fvalidm, fmask, fdrop = _shuffle_side(
-        lf, fact_datas, fact_valid, fact_datas[spec.fact_key_idx],
+        lf, fact_datas, fact_valid, fshuf,
         axis_name, spec.fact_capacity, num_partitions)
     bdatas, bvalidm, bmask, bdrop = _shuffle_side(
-        lb, build_datas, build_valid, build_datas[spec.build_key_idx],
+        lb, build_datas, build_valid, bshuf,
         axis_name, spec.build_capacity, num_partitions)
+
+    fkey, flive = _key_lane(spec, spec.fact_key_idx, fdatas, fvalidm, fmask)
+    bkey, blive = _key_lane(spec, spec.build_key_idx, bdatas, bvalidm, bmask)
 
     if spec.key_span > 0:
         # dense-key fast path (the ops/join_plan.py heuristic applied per
@@ -118,8 +164,6 @@ def _local_join_agg(spec: JoinAggSpec, axis_name, num_partitions,
         # wraps NEGATIVE scatter indices even under mode="drop" (only
         # OOB-high drops), so bad rows are where()-routed to slot span.
         span = spec.key_span
-        fkey = fdatas[spec.fact_key_idx]
-        flive = fmask & fvalidm[:, spec.fact_key_idx]
         fd = fkey.astype(jnp.int64) - spec.key_min
         f_ok = flive & (fd >= 0) & (fd < span)
         fslot = jnp.where(f_ok, fd, jnp.int64(span))
@@ -130,8 +174,6 @@ def _local_join_agg(spec: JoinAggSpec, axis_name, num_partitions,
         slot_cnts = jnp.zeros(span + 1, jnp.int32).at[fslot].add(
             f_ok.astype(jnp.int32), mode="drop")[:span]
 
-        bkey = bdatas[spec.build_key_idx]
-        blive = bmask & bvalidm[:, spec.build_key_idx]
         bd = bkey.astype(jnp.int64) - spec.key_min
         b_ok = blive & (bd >= 0) & (bd < span)
         bslot = jnp.clip(bd, 0, span - 1)
@@ -149,9 +191,9 @@ def _local_join_agg(spec: JoinAggSpec, axis_name, num_partitions,
     # after any live row with the same value (secondary dead-flag lane), so
     # the leftmost-equal searchsorted position always lands on a LIVE row
     # when one exists — a legitimate key equal to the dtype max still joins
-    bkey = bdatas[spec.build_key_idx]
+    # (composite lanes are < prod(key_spans) < 2^63, so the sentinel can
+    # never collide with a live packed tuple)
     sent = jnp.asarray(np.iinfo(np.dtype(bkey.dtype)).max, bkey.dtype)
-    blive = bmask & bvalidm[:, spec.build_key_idx]
     bkey = jnp.where(blive, bkey, sent)
     dead = (~blive).astype(jnp.int32)
     order = jnp.lexsort((dead, bkey))     # primary bkey, live before dead
@@ -166,8 +208,6 @@ def _local_join_agg(spec: JoinAggSpec, axis_name, num_partitions,
                             (bkey_s[1:] != bkey_s[:-1]).astype(jnp.int32)])
     run_id = jnp.cumsum(head) - 1                       # int32 [nb]
 
-    fkey = fdatas[spec.fact_key_idx]
-    flive = fmask & fvalidm[:, spec.fact_key_idx]
     pos = jnp.clip(jnp.searchsorted(bkey_s, fkey), 0, max(nb - 1, 0))
     hit = flive & (bkey_s[pos] == fkey) & blive_s[pos]
 
@@ -268,7 +308,7 @@ def _bucket_capacity(need: int) -> int:
 
 def repartition_join_agg_auto(mesh: jax.sharding.Mesh,
                               fact_schema, build_schema,
-                              fact_key_idx: int, build_key_idx: int,
+                              fact_key_idx, build_key_idx,
                               build_group_idx: int, fact_value_idx: int,
                               num_groups: int,
                               fact_datas: Sequence[jnp.ndarray],
@@ -281,41 +321,113 @@ def repartition_join_agg_auto(mesh: jax.sharding.Mesh,
     (one tiny sync), capacities are bucketed for compile-cache reuse, and
     the sized program runs with overflow structurally impossible.
 
+    ``fact_key_idx``/``build_key_idx`` take one column index or
+    equal-length index lists: multi-column keys are planned like
+    ``ops/join_plan.py`` — per-key build windows measured once, the tuple
+    packed into one int64 composite lane that both the shuffle routing and
+    the local probe share.  Composite windows must fit 63 bits (the shard
+    path carries no fingerprint fallback; overflow raises).
+
     The count pass also inspects the build key range and, when it is dense
     (``ops/join_plan.py`` heuristic: span ≤ max(2·n, 4096), capped), sets
     ``key_min``/``key_span`` so every shard probes by direct lookup.
     ``key_min`` is floored and the span bucketed so nearby datasets share a
     compile-cache entry."""
-    need_fn = _compiled_bucket_need(mesh, axis_name)
-    nf, nb = need_fn(fact_datas[fact_key_idx], build_datas[build_key_idx])
-    needs = np.asarray(jnp.stack([nf, nb]))      # ONE host sync, two scalars
+    from ..ops import join_plan
+
+    fki = tuple(fact_key_idx) \
+        if isinstance(fact_key_idx, (list, tuple)) else fact_key_idx
+    bki = tuple(build_key_idx) \
+        if isinstance(build_key_idx, (list, tuple)) else build_key_idx
+    if isinstance(fki, tuple) != isinstance(bki, tuple) or (
+            isinstance(fki, tuple) and len(fki) != len(bki)):
+        raise ValueError("fact/build key index lists must match in length")
+    if isinstance(fki, tuple) and len(fki) == 1:
+        fki, bki = fki[0], bki[0]
+    multi = isinstance(fki, tuple)
     key_min = key_span = 0
-    bk = build_datas[build_key_idx]
-    bdt = np.dtype(bk.dtype)
-    if bdt.kind == "i" or (bdt.kind == "u" and bdt.itemsize < 8):
-        from ..ops import join_plan
-        bv = build_valid[:, build_key_idx]
-        info = np.iinfo(bdt)
-        stats = np.asarray(jnp.stack([          # one more sync, 3 scalars
-            jnp.sum(bv).astype(jnp.int64),
-            jnp.min(jnp.where(bv, bk, info.max)).astype(jnp.int64),
-            jnp.max(jnp.where(bv, bk, info.min)).astype(jnp.int64)]))
-        nvalid, kmin, kmax = (int(s) for s in stats)
-        if nvalid > 0:
-            limit = min(max(join_plan.DENSE_SPAN_FACTOR * nvalid,
-                            join_plan.DENSE_SPAN_FLOOR),
-                        join_plan.DENSE_SPAN_CAP)
-            if kmax - kmin + 1 <= limit:
-                key_min = (kmin // 4096) * 4096
-                key_span = _bucket_capacity(kmax - key_min + 1)
+    key_mins = key_spans = ()
+    if multi:
+        # per-key build windows, floored/bucketed for compile-cache reuse
+        exprs = []
+        for i in bki:
+            bk = build_datas[i]
+            bdt = np.dtype(bk.dtype)
+            if bdt.kind not in "iu" or (bdt.kind == "u"
+                                        and bdt.itemsize == 8):
+                raise ValueError(
+                    "composite repartition keys must be int-kind below 64 "
+                    "unsigned bits; pre-encode strings/decimals to codes")
+            bv = build_valid[:, i]
+            info = np.iinfo(bdt)
+            exprs += [
+                jnp.min(jnp.where(bv, bk, info.max)).astype(jnp.int64),
+                jnp.max(jnp.where(bv, bk, info.min)).astype(jnp.int64)]
+        allv = None
+        for i in bki:
+            bv = build_valid[:, i]
+            allv = bv if allv is None else (allv & bv)
+        exprs.append(jnp.sum(allv).astype(jnp.int64))
+        vals = [int(v) for v in np.asarray(jnp.stack(exprs))]  # ONE sync
+        nvalid = vals[-1]
+        mins, spans, prod = [], [], 1
+        for j in range(len(bki)):
+            kmin, kmax = vals[2 * j], vals[2 * j + 1]
+            if kmax < kmin:            # this key column is all-null
+                kmin, span = 0, 1
+            else:
+                kmin = (kmin // 64) * 64
+                span = _bucket_capacity(kmax - kmin + 1)
+            mins.append(kmin)
+            spans.append(span)
+            prod *= span
+        if prod >= 1 << 63:
+            raise ValueError(
+                "composite key windows overflow 63 bits — the distributed "
+                "shard path has no fingerprint fallback; narrow the key "
+                "ranges or join through ops.join locally")
+        key_mins, key_spans = tuple(mins), tuple(spans)
+        if nvalid > 0 and prod <= min(
+                max(join_plan.DENSE_SPAN_FACTOR * nvalid,
+                    join_plan.DENSE_SPAN_FLOOR), join_plan.DENSE_SPAN_CAP):
+            key_span = prod            # composite lane is already 0-based
+        fact_key_arr, _ = _composite_lane(fact_datas, fact_valid, fki,
+                                          key_mins, key_spans)
+        build_key_arr, _ = _composite_lane(build_datas, build_valid, bki,
+                                           key_mins, key_spans)
+    else:
+        fact_key_arr = fact_datas[fki]
+        build_key_arr = build_datas[bki]
+    need_fn = _compiled_bucket_need(mesh, axis_name)
+    nf, nb = need_fn(fact_key_arr, build_key_arr)
+    needs = np.asarray(jnp.stack([nf, nb]))      # ONE host sync, two scalars
+    if not multi:
+        bk = build_datas[bki]
+        bdt = np.dtype(bk.dtype)
+        if bdt.kind == "i" or (bdt.kind == "u" and bdt.itemsize < 8):
+            bv = build_valid[:, bki]
+            info = np.iinfo(bdt)
+            stats = np.asarray(jnp.stack([      # one more sync, 3 scalars
+                jnp.sum(bv).astype(jnp.int64),
+                jnp.min(jnp.where(bv, bk, info.max)).astype(jnp.int64),
+                jnp.max(jnp.where(bv, bk, info.min)).astype(jnp.int64)]))
+            nvalid, kmin, kmax = (int(s) for s in stats)
+            if nvalid > 0:
+                limit = min(max(join_plan.DENSE_SPAN_FACTOR * nvalid,
+                                join_plan.DENSE_SPAN_FLOOR),
+                            join_plan.DENSE_SPAN_CAP)
+                if kmax - kmin + 1 <= limit:
+                    key_min = (kmin // 4096) * 4096
+                    key_span = _bucket_capacity(kmax - key_min + 1)
     spec = JoinAggSpec(
         fact_schema=tuple(fact_schema), build_schema=tuple(build_schema),
-        fact_key_idx=fact_key_idx, build_key_idx=build_key_idx,
+        fact_key_idx=fki, build_key_idx=bki,
         build_group_idx=build_group_idx, fact_value_idx=fact_value_idx,
         num_groups=num_groups,
         fact_capacity=_bucket_capacity(needs[0]),
         build_capacity=_bucket_capacity(needs[1]),
-        key_min=key_min, key_span=key_span)
+        key_min=key_min, key_span=key_span,
+        key_mins=key_mins, key_spans=key_spans)
     # arena admission for the exchange's padded bucket buffers (both
     # sides), sized from the measured capacities before dispatch
     from .shuffle import bucket_reservation

@@ -299,3 +299,270 @@ def test_repartition_dense_spec_matches_sorted():
     assert int(np.asarray(dd)) == 0 and int(np.asarray(sd)) == 0
     np.testing.assert_array_equal(np.asarray(ds), np.asarray(ss_))
     np.testing.assert_array_equal(np.asarray(dc), np.asarray(sc))
+
+
+# ---- multi-column keys: composite / fingerprint / fallback -----------------
+
+
+def _py_pairs(lcols, rcols, how):
+    """Reference multi-key equi-join on host tuples: a null in ANY key
+    column never matches; matches enumerate in build-row order (the
+    engines' stable key-sorted tie order)."""
+    nl, nr = len(lcols[0][0]), len(rcols[0][0])
+    rmap = {}
+    for j in range(nr):
+        if any(v is not None and not v[j] for _, v in rcols):
+            continue
+        rmap.setdefault(tuple(a[j] for a, _ in rcols), []).append(j)
+    out = []
+    for i in range(nl):
+        null = any(v is not None and not v[i] for _, v in lcols)
+        matches = [] if null else rmap.get(tuple(a[i] for a, _ in lcols), [])
+        if how == "inner":
+            out += [(i, j) for j in matches]
+        elif how == "left":
+            out += [(i, j) for j in matches] or [(i, -1)]
+        elif how == "semi":
+            out += [i] if matches else []
+        else:
+            out += [] if matches else [i]
+    return out
+
+
+def _got_pairs(res, how):
+    if how in ("semi", "anti"):
+        return np.asarray(res).tolist()
+    li, ri = res
+    return list(zip(np.asarray(li).tolist(), np.asarray(ri).tolist()))
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+def test_composite_2key_engines_and_oracle(how):
+    n, m = 1500, 400
+    la = RNG.integers(0, 40, n, dtype=np.int64)
+    lb = RNG.integers(0, 30, n).astype(np.int32)    # mixed key widths
+    ra = RNG.integers(0, 40, m, dtype=np.int64)
+    rb = RNG.integers(0, 30, m).astype(np.int32)
+    lv = RNG.random(n) < 0.9
+    rv = RNG.random(m) < 0.9
+    lt = [int_col(la, validity=lv), int_col(lb)]
+    rt = [int_col(ra), int_col(rb, validity=rv)]
+    plan = join_plan.plan_keys(lt, rt)
+    assert plan.mode == "composite" and plan.dense_ok and not plan.verify
+    d, s = _both_engines(lt, rt, how)
+    _assert_same(d, s)
+    ref = _py_pairs([(la, lv), (lb, None)], [(ra, None), (rb, rv)], how)
+    assert _got_pairs(d, how) == ref
+
+
+def test_composite_3key_vs_pandas():
+    n, m = 2000, 500
+    lk = [RNG.integers(0, 12, n, dtype=np.int64) for _ in range(3)]
+    rk = [RNG.integers(0, 12, m, dtype=np.int64) for _ in range(3)]
+    lv = RNG.random(n) < 0.92
+    lt = [int_col(lk[0], validity=lv), int_col(lk[1]), int_col(lk[2])]
+    rt = [int_col(rk[0]), int_col(rk[1]), int_col(rk[2])]
+    assert join_plan.plan_keys(lt, rt).mode == "composite"
+    # null keys → per-row sentinels outside the key range, so a plain
+    # pandas merge reproduces SQL null-never-matches semantics
+    a = lk[0].copy()
+    a[~lv] = -1000 - np.arange(np.count_nonzero(~lv))
+    ldf = pd.DataFrame({"a": a, "b": lk[1], "c": lk[2], "li": np.arange(n)})
+    rdf = pd.DataFrame({"a": rk[0], "b": rk[1], "c": rk[2],
+                        "rj": np.arange(m)})
+    for how in ("inner", "left"):
+        li, ri = join_indices(lt, rt, how)
+        mg = ldf.merge(rdf, on=["a", "b", "c"], how=how)
+        exp = sorted(zip(mg["li"].tolist(),
+                         mg["rj"].fillna(-1).astype(int).tolist()))
+        assert sorted(_got_pairs((li, ri), how)) == exp
+
+
+def test_composite_string_int_key():
+    cats = [f"s{i}" for i in range(9)]
+    n, m = 1200, 300
+    ls = [cats[i] for i in RNG.integers(0, 9, n)]
+    rs = [cats[i] for i in RNG.integers(0, 9, m)]
+    lb = RNG.integers(0, 25, n, dtype=np.int64)
+    rb = RNG.integers(0, 25, m, dtype=np.int64)
+    lt = [Column.strings_from_list(ls), int_col(lb)]
+    rt = [Column.strings_from_list(rs), int_col(rb)]
+    # dictionary codes from the shared encode are dense-eligible → packed
+    assert join_plan.plan_keys(lt, rt).mode == "composite"
+    li, ri = join_indices(lt, rt, "inner")
+    got = sorted((ls[i], int(lb[i]), int(rb[j]))
+                 for i, j in _got_pairs((li, ri), "inner"))
+    df = pd.merge(pd.DataFrame({"s": ls, "b": lb}),
+                  pd.DataFrame({"s": rs, "b": rb}), on=["s", "b"])
+    assert got == sorted(zip(df["s"], df["b"], df["b"]))
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+def test_fingerprint_overflow_matches_oracle(how):
+    # two wide-window int64 keys: span product overflows 63 bits → the
+    # planner probes on a murmur3 fingerprint and verifies tuple equality
+    n, m = 900, 250
+    base = RNG.integers(-2**61, 2**61, 60, dtype=np.int64)
+    la, ra = base[RNG.integers(0, 60, n)], base[RNG.integers(0, 60, m)]
+    lb, rb = base[RNG.integers(0, 60, n)], base[RNG.integers(0, 60, m)]
+    lv = RNG.random(n) < 0.9
+    lt = [int_col(la, validity=lv), int_col(lb)]
+    rt = [int_col(ra), int_col(rb)]
+    plan = join_plan.plan_keys(lt, rt)
+    assert plan.mode == "fingerprint" and plan.verify and not plan.dense_ok
+    got = _got_pairs(join_indices(lt, rt, how), how)
+    ref = _py_pairs([(la, lv), (lb, None)], [(ra, None), (rb, None)], how)
+    assert sorted(got) == sorted(ref)
+    if how == "left":   # engine emits probe-row-major order, like expansion
+        assert got == ref
+
+
+def test_fallback_f64_key_matches_oracle():
+    # an f64 lane can never pack exactly → hashed probe, counted "fallback"
+    n, m = 800, 200
+    lf = (RNG.integers(0, 20, n) / 4.0).astype(np.float64)
+    rf = (RNG.integers(0, 20, m) / 4.0).astype(np.float64)
+    lb = RNG.integers(0, 10, n, dtype=np.int64)
+    rb = RNG.integers(0, 10, m, dtype=np.int64)
+    lt = [Column.from_numpy(lf), int_col(lb)]
+    rt = [Column.from_numpy(rf), int_col(rb)]
+    plan = join_plan.plan_keys(lt, rt)
+    assert plan.mode == "fallback" and plan.verify
+    got = _got_pairs(join_indices(lt, rt, "inner"), "inner")
+    ref = _py_pairs([(lf, None), (lb, None)], [(rf, None), (rb, None)],
+                    "inner")
+    assert sorted(got) == sorted(ref)
+
+
+def test_fingerprint_collisions_are_rejected(monkeypatch):
+    # cripple the fingerprint to 5 buckets: every probe drowns in
+    # collisions, the verification pass must still reject them all
+    from spark_rapids_jni_tpu.ops import hashing
+
+    monkeypatch.setattr(
+        hashing, "fingerprint64",
+        lambda lanes: (lanes[0].astype(jnp.int64) % 5 + 5) % 5)
+    n, m = 400, 120
+    la = RNG.integers(-2**61, 2**61, n, dtype=np.int64)
+    ra = np.concatenate([la[RNG.integers(0, n, 60)],
+                         RNG.integers(-2**61, 2**61, m - 60, dtype=np.int64)])
+    lb = RNG.integers(0, 4, n, dtype=np.int64)
+    rb = RNG.integers(0, 4, m, dtype=np.int64)
+    lt = [int_col(la), int_col(lb)]
+    rt = [int_col(ra), int_col(rb)]
+    for how in ("inner", "left", "semi", "anti"):
+        got = _got_pairs(join_indices(lt, rt, how), how)
+        ref = _py_pairs([(la, None), (lb, None)], [(ra, None), (rb, None)],
+                        how)
+        assert sorted(got) == sorted(ref)
+
+
+def test_single_key_list_equals_scalar_key():
+    lk = int_col(RNG.integers(0, 90, 700, dtype=np.int64))
+    rk = int_col(RNG.integers(0, 90, 200, dtype=np.int64))
+    _assert_same(join_indices([lk], [rk], "inner"),
+                 join_indices(lk, rk, "inner"))
+    assert join_plan.plan_keys([lk], [rk]).mode == "single"
+
+
+def test_multikey_pack_counters_and_cache_hits():
+    from spark_rapids_jni_tpu.utils import metrics
+
+    metrics.set_enabled(True)
+    metrics.reset()
+    try:
+        lt = [int_col(RNG.integers(0, 50, 1000, dtype=np.int64)),
+              int_col(RNG.integers(0, 20, 1000, dtype=np.int64))]
+        rt = [int_col(RNG.integers(0, 50, 300, dtype=np.int64)),
+              int_col(RNG.integers(0, 20, 300, dtype=np.int64))]
+        a = join_indices(lt, rt, "inner")
+        b = join_indices(lt, rt, "inner")   # same buffers → both caches hit
+        _assert_same(a, b)
+        c = metrics.snapshot()["counters"]
+        assert c["join.pack.composite"] == 1
+        assert c["join.pack.cache_hit"] >= 1
+        assert c["join.build_index.cache_hit"] >= 1
+    finally:
+        metrics.reset()
+        metrics.set_enabled(None)
+
+
+# ---- left-outer join→aggregate fusion --------------------------------------
+
+
+def _fused_vs_unfused_how(lt, rt, left_on, right_on, keys, aggs, how):
+    fused = ops.join_aggregate(lt, rt, left_on, right_on, keys, aggs,
+                               how=how)
+    j = (ops.inner_join if how == "inner" else ops.left_join)(
+        lt, rt, left_on, right_on)
+    ref = ops.groupby_aggregate(j, keys, aggs)
+    ks = list(range(len(keys)))
+    fused = ops.sort_table(fused, ks)
+    ref = ops.sort_table(ref, ks)
+    assert fused.num_rows == ref.num_rows
+    for i in range(ref.num_columns):
+        assert fused[i].to_pylist() == ref[i].to_pylist()
+
+
+def test_fused_left_unique_build():
+    # unmatched probe rows keep null build columns — incl. the null group
+    n, nd = 3000, 300
+    dim_sk = np.arange(10, 10 + nd, dtype=np.int64)
+    dim_cat = RNG.integers(0, 7, nd, dtype=np.int64)
+    fk = np.where(RNG.random(n) < 0.8, dim_sk[RNG.integers(0, nd, n)],
+                  RNG.integers(9000, 9500, n)).astype(np.int64)
+    val = RNG.integers(-40, 40, n, dtype=np.int64)
+    vv = RNG.random(n) < 0.9
+    lt = Table([int_col(fk), int_col(val, validity=vv)])
+    rt = Table([int_col(dim_sk), int_col(dim_cat)])
+    _fused_vs_unfused_how(lt, rt, 0, 0, [3],
+                          [(1, "sum"), (1, "count"), (1, "mean"),
+                           (1, "min"), (1, "max")], "left")
+
+
+def test_fused_left_weighted_duplicate_build():
+    # unmatched rows weight 1 (their single null-extended joined row)
+    n, nb = 2000, 250
+    base = np.arange(0, 80, dtype=np.int64)
+    bk = base[RNG.integers(0, 80, nb)].astype(np.int64)
+    fk = np.where(RNG.random(n) < 0.7, base[RNG.integers(0, 80, n)],
+                  RNG.integers(500, 700, n)).astype(np.int64)
+    grp = RNG.integers(0, 5, n, dtype=np.int64)
+    val = RNG.integers(-9, 9, n, dtype=np.int64)
+    vv = RNG.random(n) < 0.85
+    lt = Table([int_col(fk), int_col(grp), int_col(val, validity=vv)])
+    rt = Table([int_col(bk)])
+    _fused_vs_unfused_how(lt, rt, 0, 0, [1],
+                          [(2, "sum"), (2, "count"), (2, "mean"),
+                           (2, "min"), (2, "max")], "left")
+
+
+def test_fused_multikey_composite_inner_and_left():
+    n, nd = 2500, 160
+    da = np.repeat(np.arange(40, dtype=np.int64), 4)
+    db = np.tile(np.arange(4, dtype=np.int64), 40)      # unique (a, b) pairs
+    dcat = RNG.integers(0, 6, nd, dtype=np.int64)
+    fa = np.where(RNG.random(n) < 0.85, RNG.integers(0, 40, n),
+                  RNG.integers(90, 120, n)).astype(np.int64)
+    fb = RNG.integers(0, 4, n, dtype=np.int64)
+    val = RNG.integers(0, 50, n, dtype=np.int64)
+    lt = Table([int_col(fa), int_col(fb), int_col(val)])
+    rt = Table([int_col(da), int_col(db), int_col(dcat)])
+    for how in ("inner", "left"):
+        _fused_vs_unfused_how(lt, rt, [0, 1], [0, 1], [5],
+                              [(2, "sum"), (2, "count")], how)
+
+
+def test_fused_fingerprint_falls_back_to_join():
+    # hashed probe counts are candidate counts — fusion must not trust them
+    n, m = 600, 100
+    base = RNG.integers(-2**61, 2**61, 50, dtype=np.int64)
+    fa, fb = base[RNG.integers(0, 50, n)], base[RNG.integers(0, 50, n)]
+    ba, bb = base[RNG.integers(0, 50, m)], base[RNG.integers(0, 50, m)]
+    grp = RNG.integers(0, 4, n, dtype=np.int64)
+    val = RNG.integers(0, 9, n, dtype=np.int64)
+    lt = Table([int_col(fa), int_col(fb), int_col(grp), int_col(val)])
+    rt = Table([int_col(ba), int_col(bb)])
+    for how in ("inner", "left"):
+        _fused_vs_unfused_how(lt, rt, [0, 1], [0, 1], [2],
+                              [(3, "sum"), (3, "count")], how)

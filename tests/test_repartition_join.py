@@ -212,3 +212,57 @@ def test_max_value_key_still_joins(mesh):
     assert dropped == 0
     assert cnts.tolist() == [n_fact // 2, 0, n_fact // 2]
     assert sums.tolist() == [n_fact // 2, 0, n_fact // 2]
+
+
+def test_multikey_composite_auto_matches_pandas(mesh):
+    # 2-column key packed into one composite lane: shuffle routing and the
+    # local dense probe share it, so both sides of a tuple key still land
+    # on one chip and results match a host pandas multi-key merge exactly
+    rng = np.random.default_rng(17)
+    n_fact, n_item, n_cat = 2048, 256, 6
+    item_a = rng.integers(100, 160, n_item).astype(np.int64)
+    item_b = rng.integers(0, 12, n_item).astype(np.int32)
+    item_cat = rng.integers(0, n_cat, n_item).astype(np.int32)
+    fact_a = np.where(rng.random(n_fact) < 0.8,
+                      rng.integers(100, 160, n_fact),
+                      rng.integers(900, 950, n_fact)).astype(np.int64)
+    fact_b = rng.integers(0, 12, n_fact).astype(np.int32)
+    fact_qty = rng.integers(1, 30, n_fact).astype(np.int64)
+    fv = np.ones((n_fact, 3), bool)
+    iv = np.ones((n_item, 3), bool)
+    fv[:, 0] = rng.random(n_fact) < 0.9      # null keys never match
+    iv[:, 1] = rng.random(n_item) < 0.9
+    sums, cnts, dropped = repartition_join_agg_auto(
+        mesh, (sr.int64, sr.int32, sr.int64), (sr.int64, sr.int32, sr.int32),
+        [0, 1], [0, 1], 2, 2, n_cat,
+        (jnp.asarray(fact_a), jnp.asarray(fact_b), jnp.asarray(fact_qty)),
+        jnp.asarray(fv),
+        (jnp.asarray(item_a), jnp.asarray(item_b), jnp.asarray(item_cat)),
+        jnp.asarray(iv))
+    df_i = pd.DataFrame({"a": item_a, "b": item_b,
+                         "cat": item_cat})[iv[:, 0] & iv[:, 1]]
+    df_f = pd.DataFrame({"a": fact_a, "b": fact_b,
+                         "qty": fact_qty})[fv[:, 0] & fv[:, 1]]
+    g = df_f.merge(df_i, on=["a", "b"]).groupby("cat")["qty"].agg(
+        ["sum", "count"])
+    want_s = np.zeros(n_cat, np.int64)
+    want_c = np.zeros(n_cat, np.int64)
+    want_s[g.index.to_numpy()] = g["sum"].to_numpy()
+    want_c[g.index.to_numpy()] = g["count"].to_numpy()
+    assert int(np.asarray(dropped)) == 0
+    np.testing.assert_array_equal(np.asarray(sums), want_s)
+    np.testing.assert_array_equal(np.asarray(cnts), want_c)
+
+
+def test_multikey_overflow_raises(mesh):
+    # 63-bit window overflow: the shard path has no fingerprint fallback
+    big = np.asarray([-2**61, 2**61], np.int64)
+    fd = (jnp.asarray(big), jnp.asarray(big), jnp.asarray([1, 1], np.int64))
+    bd = (jnp.asarray(big), jnp.asarray(big),
+          jnp.asarray([0, 1], np.int32))
+    v = jnp.ones((2, 3), bool)
+    with pytest.raises(ValueError, match="63"):
+        repartition_join_agg_auto(
+            mesh, (sr.int64, sr.int64, sr.int64),
+            (sr.int64, sr.int64, sr.int32),
+            [0, 1], [0, 1], 2, 2, 2, fd, v, bd, v)

@@ -352,3 +352,58 @@ def test_run_all_executes_every_query(files):
     assert len(outs) == len(tpcds.QUERIES) >= 21
     for name, t in outs.items():
         assert t.num_rows >= 0, name
+
+
+# ---- round-6 additions: composite multi-key joins + left-outer fusion ----
+
+def test_q_channel_day(tables, dfs):
+    out = tpcds.q_channel_day(tables)
+    ss, ws, item = dfs["store_sales"], dfs["web_sales"], dfs["item"]
+    s_rev = (ss.groupby(["ss_item_sk", "ss_sold_date_sk"], as_index=False)
+             ["ss_ext_sales_price"].sum())
+    w_rev = (ws.groupby(["ws_item_sk", "ws_sold_date_sk"], as_index=False)
+             ["ws_ext_sales_price"].sum())
+    j = (s_rev.merge(w_rev, left_on=["ss_item_sk", "ss_sold_date_sk"],
+                     right_on=["ws_item_sk", "ws_sold_date_sk"])
+         .merge(item, left_on="ss_item_sk", right_on="i_item_sk"))
+    exp = (j.groupby("i_category", as_index=False)
+           .agg(s=("ss_ext_sales_price", "sum"),
+                w=("ws_ext_sales_price", "sum")))
+    _assert_result(out, exp, ["i_category"], [("s", "float"), ("w", "float")])
+
+
+def test_q_web_also_qty(tables, dfs):
+    out = tpcds.q_web_also_qty(tables)
+    ss, ws = dfs["store_sales"], dfs["web_sales"]
+    pairs = ws[["ws_item_sk", "ws_sold_date_sk"]].drop_duplicates()
+    j = ss.merge(pairs, left_on=["ss_item_sk", "ss_sold_date_sk"],
+                 right_on=["ws_item_sk", "ws_sold_date_sk"])
+    exp = (j.groupby("ss_store_sk", as_index=False)["ss_quantity"].sum())
+    _assert_result(out, exp, ["ss_store_sk"], [("ss_quantity", "float")])
+
+
+def test_q_brand_rev_left(tables, dfs):
+    out = tpcds.q_brand_rev_left(tables, manager_id=28)
+    ss, item = dfs["store_sales"], dfs["item"]
+    item_f = item[item.i_manager_id == 28]
+    j = ss.merge(item_f, left_on="ss_item_sk", right_on="i_item_sk",
+                 how="left")
+    exp = (j.groupby("i_brand_id", dropna=False, as_index=False)
+           .agg(s=("ss_ext_sales_price", "sum"), c=("ss_item_sk", "count"))
+           .sort_values("i_brand_id", na_position="last",
+                        ignore_index=True))
+    assert out.num_rows == len(exp)
+    # brand ids incl. the null group for every non-selected item's sales
+    got_b = out[0].to_pylist()
+    exp_b = [None if pd.isna(b) else int(b) for b in exp["i_brand_id"]]
+    # our sort may place the null key first or last — align on key value
+    if got_b[0] is None:
+        got_b = got_b[1:] + [None]
+        perm = list(range(1, len(exp))) + [0]
+    else:
+        perm = list(range(len(exp)))
+    assert got_b == exp_b
+    got_s = np.asarray(out[1].to_numpy(), dtype=np.float64)[perm]
+    got_c = np.asarray(out[2].to_numpy())[perm]
+    np.testing.assert_allclose(got_s, exp["s"].to_numpy(), rtol=1e-9)
+    assert got_c.tolist() == exp["c"].tolist()

@@ -26,9 +26,19 @@ Cold-build runs rotate through pre-copied key buffers so each iteration
 misses the identity-keyed index memo; cached runs reuse one buffer so
 every iteration hits it.
 
+Multi-key cases (ISSUE 4) ride the same harness: 2-key / 3-key int
+tuples and string+int pack onto one int64 composite
+(``join_plan.plan_keys``) and run both engines; the wide-window case
+overflows 63 bits and takes the fingerprint-and-verify path.  A pandas
+``merge`` on the same host data anchors the largest composite case, and a
+repeated probe records that the pack-plan and build-index cache-hit
+counters fire.
+
 Acceptance (ISSUE 1): dense ≥ 10× sort-probe on the 10M-probe / 1M-build
 dense-key inner join (warm, in-jit engine basis); cached build ≥ 5× cold
-on a build-dominant shape.
+on a build-dominant shape.  (ISSUE 4): 2-key dense-composite ≥ 2× the
+sort-probe baseline at the largest 2-key size; cache-hit counters fire on
+repeated multi-key probes.
 
 Usage: python tools/join_bench.py [out.json]
 """
@@ -38,6 +48,7 @@ import sys
 import time
 
 import numpy as np
+import pandas as pd
 
 sys.path.insert(0, ".")
 
@@ -47,6 +58,7 @@ import jax.numpy as jnp
 from spark_rapids_jni_tpu import Column
 from spark_rapids_jni_tpu.ops import join_plan
 from spark_rapids_jni_tpu.ops.join import join_indices
+from spark_rapids_jni_tpu.utils import metrics
 
 ITERS = 5
 RESULTS = {"backend": None, "cases": {}, "acceptance": {}}
@@ -166,6 +178,137 @@ def bench_cached(name, lk, rk):
     return entry
 
 
+def _mcol(datas, copies=1):
+    """One multi-key column list per copy — distinct buffers per copy so
+    rotating copies misses both the pack-plan memo and the index memo."""
+    return [[Column.from_numpy(d) for d in datas] for _ in range(copies)]
+
+
+def bench_multikey(name, note, lks, rks, engines=("sorted", "dense")):
+    """Time ``join_indices`` on a key-column LIST (composite/fingerprint
+    path) — same cold-build rotation discipline as :func:`bench_case`."""
+    plan = join_plan.plan_keys([Column.from_numpy(d) for d in lks],
+                               [Column.from_numpy(d) for d in rks])
+    entry = {"note": note, "n_probe": int(lks[0].shape[0]),
+             "n_build": int(rks[0].shape[0]), "n_keys": len(lks),
+             "pack_mode": plan.mode}
+    lcols = _mcol(lks)
+    rcols = _mcol(rks, copies=ITERS + 1)
+    for eng in engines:
+        entry[f"{eng}_cold_s"] = _time_join(lcols, rcols, eng)
+    if len(engines) == 2:
+        entry["dense_speedup_vs_sorted"] = round(
+            entry["sorted_cold_s"] / entry["dense_cold_s"], 2)
+    RESULTS["cases"][name] = entry
+    print(f"  {name}: " + ", ".join(
+        f"{k}={v}" for k, v in entry.items() if k != "note"), flush=True)
+    return entry
+
+
+def _pair_keys(rng, n_probe, n_build, spans, match_frac=0.85):
+    """Unique build tuples over mixed-radix ``spans``; probe tuples hit a
+    build tuple with ``match_frac`` probability (misses stay inside the
+    windows, so they exercise the probe, not the validity fold)."""
+    idx = np.arange(n_build, dtype=np.int64)
+    rks = []
+    for s in reversed(spans):
+        rks.append(idx % s)
+        idx = idx // s
+    rks = rks[::-1]
+    sel = rng.integers(0, n_build, n_probe)
+    lks = [rk[sel].copy() for rk in rks]
+    miss = rng.random(n_probe) >= match_frac
+    lks[-1] = np.where(miss, (lks[-1] + 1) % spans[-1], lks[-1])
+    return lks, rks
+
+
+def bench_multikey_cases(rng):
+    # 2-key int: the acceptance sweep — largest size is the basis
+    acc = None
+    for n_probe, n_build in ((1_000_000, 100_000), (4_000_000, 400_000)):
+        print(f"2-key composite ({n_probe // 1_000_000}M probe / "
+              f"{n_build // 1_000} K build):", flush=True)
+        lks, rks = _pair_keys(rng, n_probe, n_build,
+                              ((n_build + 255) // 256, 256))
+        acc = bench_multikey(
+            f"composite_2key_{n_probe // 1_000_000}M",
+            "unique (a, b) build tuples packed onto the dense LUT",
+            lks, rks)
+    # pandas anchor on the largest 2-key shape (full merge — it also
+    # materializes the output, so treat as a reference point, not a race)
+    ldf = pd.DataFrame({"a": lks[0], "b": lks[1]})
+    rdf = pd.DataFrame({"a": rks[0], "b": rks[1], "r": np.arange(len(rks[0]))})
+    t0 = time.perf_counter()
+    ldf.merge(rdf, on=["a", "b"])
+    acc["pandas_merge_s"] = time.perf_counter() - t0
+    print(f"  pandas merge (largest 2-key): {acc['pandas_merge_s']:.3f}s",
+          flush=True)
+
+    # 3-key int
+    print("3-key composite (2M probe / 300K build):", flush=True)
+    lks, rks = _pair_keys(rng, 2_000_000, 300_000, (19, 64, 256))
+    bench_multikey("composite_3key_2M",
+                   "three-radix pack, still one int64 composite lane",
+                   lks, rks)
+
+    # string + int: dictionary codes from the shared encode pack like ints;
+    # both engines pay the encode, the LUT-vs-searchsorted gap remains
+    print("string+int composite (500K probe / 100K build):", flush=True)
+    cats = np.asarray([f"cat_{i:04d}" for i in range(16)])
+    idx = np.arange(100_000, dtype=np.int64)
+    rs = cats[(idx // 8192).astype(np.int64)]     # unique (cat, i) tuples
+    ri = idx % 8192                               # code·int window < cap
+    sel = rng.integers(0, 100_000, 500_000)
+    miss = rng.random(500_000) >= 0.85
+    ls = rs[sel]
+    li = np.where(miss, (ri[sel] + 1) % 8192, ri[sel])
+
+    def _sv(vals):
+        return Column.strings_from_list([str(v) for v in vals])
+
+    plan = join_plan.plan_keys([_sv(ls), Column.from_numpy(li)],
+                               [_sv(rs), Column.from_numpy(ri)])
+    entry = {"note": "shared-dict codes + int payload", "n_probe": 500_000,
+             "n_build": 100_000, "n_keys": 2, "pack_mode": plan.mode}
+    lcols = [[_sv(ls), Column.from_numpy(li)]]
+    rcols = [[_sv(rs), Column.from_numpy(ri)] for _ in range(ITERS + 1)]
+    for eng in ("sorted", "dense"):
+        entry[f"{eng}_cold_s"] = _time_join(lcols, rcols, eng)
+    entry["dense_speedup_vs_sorted"] = round(
+        entry["sorted_cold_s"] / entry["dense_cold_s"], 2)
+    RESULTS["cases"]["composite_string_int_500K"] = entry
+    print("  composite_string_int_500K: " + ", ".join(
+        f"{k}={v}" for k, v in entry.items() if k != "note"), flush=True)
+
+    # overflow → fingerprint-and-verify (no dense window exists)
+    print("fingerprint overflow (1M probe / 200K build):", flush=True)
+    wide = rng.integers(-2**61, 2**61, 200_000, dtype=np.int64)
+    sel = rng.integers(0, 200_000, 1_000_000)
+    bench_multikey("fingerprint_2key_1M",
+                   "63-bit window overflow — murmur3 probe + verify",
+                   [wide[sel], wide[::-1][sel]], [wide, wide[::-1]],
+                   engines=("sorted",))
+
+    # repeated probe: pack-plan + build-index cache hits must fire
+    metrics.set_enabled(True)
+    metrics.reset()
+    lt = [Column.from_numpy(d) for d in lks]
+    rt = [Column.from_numpy(d) for d in rks]
+    _block(join_indices(lt, rt, "inner"))
+    t0 = time.perf_counter()
+    _block(join_indices(lt, rt, "inner"))
+    counters = metrics.snapshot()["counters"]
+    hits = {k: int(v) for k, v in counters.items()
+            if k in ("join.pack.cache_hit", "join.build_index.cache_hit")}
+    RESULTS["cases"]["multikey_repeat_probe"] = {
+        "note": "second probe of the same key buffers",
+        "repeat_s": time.perf_counter() - t0, "cache_hit_counters": hits}
+    metrics.reset()
+    metrics.set_enabled(None)
+    print(f"  multikey_repeat_probe: cache_hit_counters={hits}", flush=True)
+    return acc, hits
+
+
 def main():
     RESULTS["backend"] = jax.default_backend()
     rng = np.random.default_rng(0)
@@ -206,11 +349,19 @@ def main():
     print("cached vs cold build index (64K probe / 1M build):", flush=True)
     cache = bench_cached("cached_build_64K_probe", small_probe, build_1to1)
 
+    mk, hits = bench_multikey_cases(rng)
+
     RESULTS["acceptance"] = {
         "dense_speedup_vs_sorted_10M": acc["dense_speedup_vs_sorted"],
         "dense_ge_10x": acc["dense_speedup_vs_sorted"] >= 10.0,
         "cached_speedup_vs_cold": cache["cached_speedup_vs_cold"],
         "cached_ge_5x": cache["cached_speedup_vs_cold"] >= 5.0,
+        "composite_2key_speedup_vs_sorted_largest":
+            mk["dense_speedup_vs_sorted"],
+        "composite_2key_ge_2x": mk["dense_speedup_vs_sorted"] >= 2.0,
+        "multikey_cache_hits_fire": all(
+            hits.get(k, 0) >= 1 for k in
+            ("join.pack.cache_hit", "join.build_index.cache_hit")),
     }
     out = sys.argv[1] if len(sys.argv) > 1 else "JOIN_BENCH.json"
     with open(out, "w") as f:
