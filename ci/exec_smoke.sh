@@ -1,0 +1,118 @@
+#!/usr/bin/env bash
+# Serving-runtime smoke — the exec/ analog of ci/arena_smoke.sh: serve a
+# small TPC-DS mix through the concurrent QueryScheduler and assert the
+# serving contract end to end: (1) concurrent responses bit-identical to
+# serial eager execution, (2) typed backpressure (ExecQueueFull) and
+# deadline errors surface instead of stalls, (3) a tight
+# SRJT_EXEC_INFLIGHT_BYTES cap completes the whole mix via degraded
+# admission (sorted join engine) with ≥1 exec.admission.degraded counted
+# and zero wrong results.  Artifacts land in target/exec_smoke/.
+#
+# Usage: ci/exec_smoke.sh [n_sales] [queries]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N_SALES="${1:-50000}"
+QUERIES="${2:-q3,q42,q55}"
+OUT=target/exec_smoke
+mkdir -p "$OUT"
+
+echo "== exec smoke: $QUERIES over $N_SALES rows =="
+XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+SPARK_RAPIDS_TPU_METRICS=1 SRJT_EXEC=1 \
+SRJT_SMOKE_OUT="$OUT" SRJT_SMOKE_N="$N_SALES" SRJT_SMOKE_Q="$QUERIES" \
+python - <<'PYEOF'
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+out = os.environ["SRJT_SMOKE_OUT"]
+n_sales = int(os.environ["SRJT_SMOKE_N"])
+qnames = os.environ["SRJT_SMOKE_Q"].split(",")
+
+import numpy as np
+
+import jax
+
+from benchmarks import tpcds_data
+from spark_rapids_jni_tpu import exec as xc
+from spark_rapids_jni_tpu.models import tpcds
+from spark_rapids_jni_tpu.utils import metrics
+
+assert xc.enabled(), "SRJT_EXEC gate did not enable"
+
+files = tpcds_data.generate(n_sales=n_sales, n_items=2_000, n_stores=10,
+                            seed=5)
+tables = tpcds.load_tables(files)
+
+def canon(result):
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(result)]
+
+oracle = {q: canon(tpcds.QUERIES[q](tables)) for q in qnames}
+
+# 1) concurrent == serial, through the full runtime (4 workers, mix x4)
+mix = [q for q in qnames for _ in range(4)]
+with xc.QueryScheduler(workers=4) as sched:
+    tickets = [sched.submit(q, tpcds.QUERIES[q], tables) for q in mix]
+    for q, tk in zip(mix, tickets):
+        got = canon(tk.result(timeout=300))
+        assert len(got) == len(oracle[q]) and all(
+            np.array_equal(a, b) for a, b in zip(got, oracle[q])), \
+            f"{q}: concurrent response differs from serial"
+print(f"concurrent identical: {len(mix)} responses over {len(qnames)} "
+      "queries")
+
+# 2) typed backpressure + deadline (no stalls, no silent drops)
+import time
+def slow(tbls, _q=qnames[0]):
+    time.sleep(0.05)
+    return tpcds.QUERIES[_q](tbls)
+full = deadline = 0
+with xc.QueryScheduler(workers=1, queue_depth=2) as tiny:
+    held = []
+    for _ in range(10):
+        try:
+            held.append(tiny.submit("slow", slow, tables, compiled=False))
+        except xc.ExecQueueFull:
+            full += 1
+    tk = None
+    while tk is None:
+        try:
+            tk = tiny.submit("dl", slow, tables, compiled=False,
+                             timeout_s=0.001)
+        except xc.ExecQueueFull:
+            time.sleep(0.02)
+    try:
+        tk.result(timeout=60)
+    except xc.ExecDeadlineExceeded:
+        deadline = 1
+    for h in held:
+        h.result(timeout=120)
+assert full >= 1, "bounded queue never rejected"
+assert deadline == 1, "deadline did not surface"
+print(f"backpressure OK: {full} queue-full rejections, typed deadline")
+
+# 3) degraded admission under a pressure cap: completes, bit-exact
+metrics.reset()
+with xc.QueryScheduler(workers=4, inflight_bytes=4096) as dsched:
+    tickets = [dsched.submit(q, tpcds.QUERIES[q], tables) for q in mix]
+    wrong = 0
+    for q, tk in zip(mix, tickets):
+        got = canon(tk.result(timeout=300))
+        wrong += not (len(got) == len(oracle[q]) and all(
+            np.array_equal(a, b) for a, b in zip(got, oracle[q])))
+snap = metrics.snapshot()["counters"]
+assert wrong == 0, f"{wrong} degraded responses wrong"
+assert snap.get("exec.admission.degraded", 0) >= 1, snap
+print(f"degraded OK: {int(snap['exec.admission.degraded'])} degraded "
+      f"admissions, 0 wrong results")
+
+with open(os.path.join(out, "summary.json"), "w") as f:
+    json.dump(metrics.summary(), f, indent=1)
+print("wrote", os.path.join(out, "summary.json"))
+PYEOF
+
+echo "exec smoke OK"

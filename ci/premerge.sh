@@ -49,6 +49,8 @@ PYEOF
 if [[ "${1:-}" != "--skip-tests" ]]; then
     echo "== tests =="
     python -m pytest tests/ -q
+    echo "== exec smoke (serving runtime) =="
+    ci/exec_smoke.sh
 fi
 
 echo "premerge OK"

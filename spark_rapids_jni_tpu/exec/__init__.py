@@ -1,0 +1,49 @@
+"""Concurrent query-serving runtime (default-off, ``SRJT_EXEC=1``).
+
+The single-query engine (scan → ops → compiled replay) answers "how fast
+is one query"; this subsystem answers the serving question — many
+concurrent requests sharing ONE device, one HBM arena, and one set of
+caches, the shape Spark's accelerated executors run in (SURVEY §1).
+Parts, each its own module:
+
+* :mod:`.scheduler` — bounded worker pool + priority queue, typed
+  backpressure, deadlines (``SRJT_EXEC_WORKERS``,
+  ``SRJT_EXEC_QUEUE_DEPTH``).
+* :mod:`.admission` — per-request HBM gate with graceful degradation
+  (``SRJT_EXEC_INFLIGHT_BYTES``): defer under pressure, force the
+  memory-lean sorted join engine when a request can never fit dense.
+* :mod:`.plan_cache` — LRU of compiled (capture/replay) plans keyed on
+  (query, input fingerprint) so the warm loop is one dispatch per
+  request (``SRJT_EXEC_PLAN_CACHE_CAP``).
+* :mod:`.prefetch` — double-buffered staging overlapping the next
+  request's scan with current execution (``SRJT_EXEC_PREFETCH_DEPTH``).
+
+Correctness contract: concurrency, admission degradation, plan caching,
+and prefetch NEVER change results — only latency.  The differential
+tests (``tests/test_exec_runtime.py``) hold serving-runtime output
+bit-identical to serial eager execution.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .admission import AdmissionController, AdmissionGrant, request_bytes
+from .errors import (ExecDeadlineExceeded, ExecError, ExecQueueFull,
+                     ExecShutdown)
+from .plan_cache import PlanCache
+from .prefetch import Prefetcher
+from .scheduler import QueryScheduler, QueryTicket
+
+__all__ = [
+    "AdmissionController", "AdmissionGrant", "ExecDeadlineExceeded",
+    "ExecError", "ExecQueueFull", "ExecShutdown", "PlanCache",
+    "Prefetcher", "QueryScheduler", "QueryTicket", "enabled",
+    "request_bytes",
+]
+
+
+def enabled() -> bool:
+    """True when the serving runtime is switched on (``SRJT_EXEC``)."""
+    return os.environ.get("SRJT_EXEC", "0").lower() \
+        not in ("0", "off", "false", "")

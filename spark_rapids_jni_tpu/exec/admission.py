@@ -1,0 +1,184 @@
+"""Per-request HBM admission for the serving runtime.
+
+``memory.budget`` answers "can THIS allocation proceed right now" at each
+allocation site; a concurrent server needs the question answered once per
+REQUEST, before any of its allocations exist — otherwise four admitted
+queries can each pass their first small charge and then collectively blow
+the arena mid-flight, where nothing can be unwound (an admitted query
+must complete; ``budget`` docstring).  This controller is that front
+gate: a global in-flight byte ledger (``SRJT_EXEC_INFLIGHT_BYTES``)
+composed with the per-query ``budget.query_budget`` scope the worker
+enters after admission.
+
+Degradation ladder (pressure NEVER fails a request that can be served):
+
+1. **fits** — estimate ≤ free in-flight room: admit on the requested
+   path (dense join engine, full working set).
+2. **defer** — estimate > free room but ≤ the cap: wait for in-flight
+   requests to drain, then admit (``exec.admission.deferred``).  Queue
+   wait is the currency overload is paid in — same as Spark's task
+   queue — not errors.
+3. **degrade** — estimate > the whole cap, so no amount of draining
+   admits it as-is: admit EXCLUSIVELY (wait until in-flight is zero,
+   hold the full cap) and tell the worker to route joins to the
+   sort-probe engine via ``ops.join_plan.force_engine("sorted")``
+   (``exec.admission.degraded``).  The sorted engine allocates O(n)
+   lanes instead of a dense O(key-range) lookup table and returns
+   bit-identical rows — the engines are differentially tested — so the
+   degraded request is slower, never wrong.
+
+Deadlines bound stage 2/3 waits: a request whose deadline passes while
+deferred raises :class:`~.errors.ExecDeadlineExceeded` instead of
+occupying the gate forever.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from ..memory import budget as mbudget
+from ..utils import metrics
+from .errors import ExecDeadlineExceeded, ExecShutdown
+
+
+def request_bytes(tables) -> int:
+    """Byte estimate for one request's input working set: every payload
+    array (device- or host-resident — a spilled input re-uploads on first
+    touch, so it counts) across the request's tables.  Inputs dominate
+    the footprint lower bound; op transients ride the per-site budget
+    charges after admission."""
+    total = 0
+    seen: set[int] = set()
+
+    def add(a):
+        nonlocal total
+        if a is not None and id(a) not in seen:
+            seen.add(id(a))
+            total += int(getattr(a, "nbytes", 0) or 0)
+
+    def col(c):
+        from ..column import LazyColumn
+        if isinstance(c, LazyColumn):
+            if c._col is None:
+                return
+            c = c._col
+        add(c.data)
+        add(getattr(c, "offsets", None))
+        add(getattr(c, "validity", None))
+        for ch in (c.children or ()):
+            col(ch)
+
+    def walk(obj):
+        from ..column import Column, Table
+        if isinstance(obj, dict):
+            for v in obj.values():
+                walk(v)
+        elif isinstance(obj, Table):
+            for c in obj.columns:
+                col(c)
+        elif isinstance(obj, Column):
+            col(obj)
+        elif isinstance(obj, (list, tuple)):
+            for v in obj:
+                walk(v)
+
+    walk(tables)
+    return total
+
+
+class AdmissionGrant:
+    """One admitted request's hold on the in-flight ledger (context
+    manager; exiting releases the bytes and wakes deferred waiters).
+    ``degrade`` tells the worker to run under ``force_engine("sorted")``."""
+
+    __slots__ = ("nbytes", "degrade", "_ctl", "_released")
+
+    def __init__(self, ctl: "AdmissionController", nbytes: int,
+                 degrade: bool):
+        self._ctl = ctl
+        self.nbytes = nbytes
+        self.degrade = degrade
+        self._released = False
+
+    def __enter__(self) -> "AdmissionGrant":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._ctl._release(self.nbytes)
+
+
+class AdmissionController:
+    """The serving gate: bounded in-flight bytes with defer/degrade."""
+
+    def __init__(self, cap_bytes=None):
+        if cap_bytes is None:
+            cap_bytes = os.environ.get("SRJT_EXEC_INFLIGHT_BYTES")
+        self.cap: Optional[int] = mbudget.parse_bytes(cap_bytes)
+        self._cv = threading.Condition(threading.Lock())
+        self._inflight = 0
+        self._closed = False
+
+    def inflight_bytes(self) -> int:
+        with self._cv:
+            return self._inflight
+
+    def close(self) -> None:
+        """Wake every deferred waiter with :class:`ExecShutdown`."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def admit(self, nbytes: int, *, name: str = "request",
+              deadline: Optional[float] = None) -> AdmissionGrant:
+        """Block until ``nbytes`` fits the in-flight cap (the ladder in
+        the module docstring), then return the grant.  ``deadline`` is an
+        absolute ``time.monotonic()`` instant bounding the wait."""
+        n = max(int(nbytes), 0)
+        cap = self.cap
+        if cap is None:
+            return AdmissionGrant(self, 0, False)
+        degrade = n > cap
+        hold = cap if degrade else n
+        # degraded requests admit exclusively: they hold the entire cap,
+        # so their true (over-cap) footprint never overlaps another
+        # request's admitted bytes
+        t0 = time.monotonic()
+        deferred = False
+        with self._cv:
+            while self._inflight + hold > cap:
+                if self._closed:
+                    raise ExecShutdown("admission gate closed")
+                if not deferred:
+                    deferred = True
+                    if metrics.recording():
+                        metrics.count("exec.admission.deferred")
+                timeout = None
+                if deadline is not None:
+                    timeout = deadline - time.monotonic()
+                    if timeout <= 0:
+                        if metrics.recording():
+                            metrics.count("exec.admission.deadline")
+                        raise ExecDeadlineExceeded(
+                            name, "admission", time.monotonic() - t0)
+                self._cv.wait(timeout)
+            self._inflight += hold
+            if metrics.recording():
+                metrics.gauge("exec.inflight_bytes", self._inflight)
+        if degrade and metrics.recording():
+            metrics.count("exec.admission.degraded")
+        return AdmissionGrant(self, hold, degrade)
+
+    def _release(self, nbytes: int) -> None:
+        with self._cv:
+            self._inflight = max(self._inflight - int(nbytes), 0)
+            if metrics.recording():
+                metrics.gauge("exec.inflight_bytes", self._inflight)
+            self._cv.notify_all()

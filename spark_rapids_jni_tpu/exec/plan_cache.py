@@ -1,0 +1,168 @@
+"""Capacity-bound LRU of compiled query plans for the serving runtime.
+
+``models/compiled.py`` gives one query ONE dispatch per execution — after
+a capture run and a jit trace that cost ~100× the steady-state dispatch.
+A server amortizes that only if compiled plans are REUSED across
+requests: this cache keys plans on (query name, input-table fingerprint)
+so the steady serving loop is a cache hit straight into raw dispatch.
+
+Key discipline (`models.compiled.plan_key`): the fingerprint covers every
+payload buffer's identity (id + dtype + shape), with weakrefs guarding
+ids against recycling.  Identity keys make staleness STRUCTURAL — arrays
+are immutable, so refreshed data is new buffers is a new key is a fresh
+capture; a hit provably presents the very buffers the plan was captured
+from.  The checked first run (one stacked sync validating the tape)
+backstops the remaining edge, and a :class:`~..models.compiled.StaleTapeError`
+there evicts and recompiles instead of surfacing to the client.
+
+Entries single-flight: two workers missing on the same key compile once
+(the second waits on the first's build event — a duplicate capture would
+waste the most expensive step the cache exists to amortize).
+
+Knobs: ``SRJT_EXEC_PLAN_CACHE_CAP`` (entries, default 32).  Counters:
+``exec.plan_cache.{hit,miss,evictions,stale,expired}``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from ..models import compiled as C
+from ..utils import metrics
+
+
+class PlanCache:
+    """LRU of :class:`~..models.compiled.CompiledQuery` keyed on
+    (query name, table fingerprint)."""
+
+    def __init__(self, cap: Optional[int] = None):
+        if cap is None:
+            cap = int(os.environ.get("SRJT_EXEC_PLAN_CACHE_CAP", "32"))
+        self.cap = max(int(cap), 1)
+        # RLock: weakref death callbacks can fire at GC points on a
+        # thread already inside the cache
+        self._mu = threading.RLock()
+        self._d: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._building: dict[tuple, threading.Event] = {}
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._d)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._d.clear()
+
+    def _evict(self, key, counter: Optional[str]) -> None:
+        with self._mu:
+            entry = self._d.pop(key, None)
+        if entry is not None and counter and metrics.recording():
+            metrics.count(counter)
+
+    def _lookup(self, key) -> Optional[dict]:
+        """The live entry for ``key`` (LRU-touched), or None.  A dead
+        weakref means a keyed buffer was collected and its id may be
+        recycled — the entry is unusable and drops here."""
+        with self._mu:
+            entry = self._d.get(key)
+            if entry is None:
+                return None
+            if any(r() is None for r in entry["refs"]):
+                self._d.pop(key, None)
+                if metrics.recording():
+                    metrics.count("exec.plan_cache.expired")
+                return None
+            self._d.move_to_end(key)
+            return entry
+
+    def get_or_compile(self, name: str, qfn: Callable, tables,
+                       variant: str = "") -> dict:
+        """The cache entry for (``name``, ``variant``, fingerprint of
+        ``tables``), compiling on miss (single-flight per key).
+
+        ``variant`` keys any ambient mode that changes the captured
+        trace — e.g. the scheduler passes ``"sorted"`` for degraded-
+        admission requests running under ``force_engine``: a tape
+        recorded on the dense join path would misalign when replayed
+        with the engine forced, so the two variants must never share an
+        entry."""
+        fp, arrays = C.plan_key(tables)
+        key = (name, variant, fp)
+        while True:
+            with self._mu:
+                entry = self._lookup(key)
+                if entry is not None:
+                    if metrics.recording():
+                        metrics.count("exec.plan_cache.hit")
+                    return entry
+                ev = self._building.get(key)
+                if ev is None:
+                    ev = self._building[key] = threading.Event()
+                    break
+            ev.wait()
+        try:
+            if metrics.recording():
+                metrics.count("exec.plan_cache.miss")
+            plan = C.compile_query(qfn, tables)
+            try:
+                refs = tuple(
+                    weakref.ref(a, lambda _, k=key: self._evict(
+                        k, "exec.plan_cache.expired"))
+                    for a in arrays)
+            except TypeError:
+                refs = ()
+            # the capture run's result IS this request's answer: hand it
+            # out once instead of re-executing, and drop the plan's own
+            # copy — cached entries must not pin result-sized memory
+            entry = {"plan": plan, "refs": refs, "verified": False,
+                     "expected": plan.expected, "key": key}
+            plan.expected = None
+            with self._mu:
+                self._d[key] = entry
+                self._d.move_to_end(key)
+                while len(self._d) > self.cap:
+                    old = next(iter(self._d))
+                    if old == key:
+                        break
+                    self._d.pop(old)
+                    if metrics.recording():
+                        metrics.count("exec.plan_cache.evictions")
+            return entry
+        finally:
+            with self._mu:
+                self._building.pop(key, None)
+            ev.set()
+
+    def invalidate(self, entry: dict) -> None:
+        self._evict(entry["key"], None)
+
+    def run(self, name: str, qfn: Callable, tables, variant: str = ""):
+        """Execute ``qfn(tables)`` through the cache.
+
+        Miss → capture-compile; the capture run's own (eager) result is
+        returned, so a cold request executes the query once, not twice.
+        First hit → checked run (one stacked sync validates the tape;
+        the identity key makes a mismatch near-impossible, the check
+        makes it impossible).  Later hits → raw single dispatch
+        (``run_unchecked``).  A stale tape evicts + recompiles — clients
+        never see :class:`StaleTapeError`."""
+        entry = self.get_or_compile(name, qfn, tables, variant)
+        expected = entry.pop("expected", None)
+        if expected is not None:
+            return expected
+        plan = entry["plan"]
+        if entry["verified"]:
+            return plan.run_unchecked(tables)
+        try:
+            out = plan.run(tables)
+            entry["verified"] = True
+            return out
+        except C.StaleTapeError:
+            if metrics.recording():
+                metrics.count("exec.plan_cache.stale")
+            self.invalidate(entry)
+            return self.run(name, qfn, tables, variant)

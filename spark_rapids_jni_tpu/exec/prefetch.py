@@ -1,0 +1,168 @@
+"""Double-buffered host→device staging for queued requests.
+
+The serving analog of the reference's copy/compute overlap (SURVEY §5.5:
+spill and shuffle copies ride side streams so the compute stream never
+waits on PCIe): while the workers execute the current requests, one
+staging thread runs the NEXT requests' loaders (parquet fused scan +
+upload — the dominant cold-request cost), so by the time a worker
+dequeues a request its tables are already device-resident.
+
+``depth`` (``SRJT_EXEC_PREFETCH_DEPTH``, default 2) bounds how many
+staged working sets exist at once — double buffering, not an unbounded
+table heap.  Staged tables are registered with ``memory.spill`` under
+the ``exec.prefetch`` tag, so under HBM pressure the arena evicts the
+*waiting* request's tables (they fault back implicitly on first touch)
+before anything the running request holds.  On ``take`` the registration
+is dropped: from that instant the table is a running plan's working set,
+which the spill registry must never touch.
+
+Counters: ``exec.prefetch.{hit,miss,rejected}``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict, deque
+from typing import Callable, Optional
+
+from ..utils import metrics
+
+
+def _register_staged(obj) -> None:
+    """Spill-register every Table in a staged loader result (a Table, or
+    a dict/sequence of them).  ``register_table`` is idempotent per table
+    object, so loaders that already registered their scan outputs are
+    not double-charged."""
+    from ..column import Table
+    from ..memory import spill as mspill
+    if isinstance(obj, Table):
+        mspill.register_table(obj, "exec.prefetch")
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _register_staged(v)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _register_staged(v)
+
+
+def _unregister_staged(obj) -> None:
+    from ..column import Table
+    from ..memory import spill as mspill
+    if isinstance(obj, Table):
+        mspill.unregister(("exec.prefetch", id(obj)))
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _unregister_staged(v)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _unregister_staged(v)
+
+
+class Prefetcher:
+    """One staging thread + a bounded slot map of loaded working sets."""
+
+    def __init__(self, depth: Optional[int] = None):
+        if depth is None:
+            depth = int(os.environ.get("SRJT_EXEC_PREFETCH_DEPTH", "2"))
+        self.depth = max(int(depth), 1)
+        self._cv = threading.Condition(threading.Lock())
+        self._slots: "OrderedDict[object, dict]" = OrderedDict()
+        self._todo: deque = deque()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name="srjt-exec-prefetch", daemon=True)
+        self._thread.start()
+
+    def stage(self, key, loader: Callable[[], object]) -> bool:
+        """Queue ``loader`` to run on the staging thread.  False (with
+        ``exec.prefetch.rejected``) when the buffer is full or the key is
+        already staged — the caller's ``take`` then loads inline, which
+        is the correct degraded behavior, not an error."""
+        with self._cv:
+            if self._closed or key in self._slots:
+                return False
+            if len(self._slots) >= self.depth:
+                if metrics.recording():
+                    metrics.count("exec.prefetch.rejected")
+                return False
+            self._slots[key] = {"state": "queued", "done": threading.Event(),
+                                "result": None, "exc": None, "loader": loader}
+            self._todo.append(key)
+            self._cv.notify_all()
+        return True
+
+    def take(self, key, loader: Optional[Callable[[], object]] = None):
+        """The staged working set for ``key`` (blocks until staged), or
+        ``loader()`` run inline on a miss.  Either way the result leaves
+        the prefetch spill registrations behind — it is about to become a
+        running plan's working set."""
+        with self._cv:
+            slot = self._slots.pop(key, None)
+            # a still-"queued" slot hasn't been picked up by the staging
+            # thread; popping it here makes the staging loop skip it, and
+            # THIS thread loads inline — waiting on it would deadlock if
+            # the loop saw the pop first and never ran the loader
+            queued = slot is not None and slot["state"] == "queued"
+        if slot is None or queued:
+            if metrics.recording():
+                metrics.count("exec.prefetch.miss")
+            if loader is None and queued:
+                loader = slot["loader"]
+            if loader is None:
+                raise KeyError(f"prefetch: {key!r} not staged, no loader")
+            return loader()
+        slot["done"].wait()
+        with self._cv:
+            self._cv.notify_all()      # a slot freed; staging may resume
+        if slot["exc"] is not None:
+            raise slot["exc"]
+        if metrics.recording():
+            metrics.count("exec.prefetch.hit")
+        result = slot["result"]
+        _unregister_staged(result)
+        return result
+
+    def discard(self, key) -> None:
+        """Drop a staged slot without delivering it (cancelled request)."""
+        with self._cv:
+            slot = self._slots.pop(key, None)
+        if slot is not None and slot["done"].is_set() \
+                and slot["exc"] is None:
+            _unregister_staged(slot["result"])
+
+    def close(self) -> None:
+        from .errors import ExecShutdown
+        with self._cv:
+            self._closed = True
+            for slot in self._slots.values():
+                if not slot["done"].is_set():
+                    slot["exc"] = ExecShutdown("prefetcher closed")
+                    slot["done"].set()
+            self._slots.clear()
+            self._todo.clear()
+            self._cv.notify_all()
+        self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._todo and not self._closed:
+                    self._cv.wait()
+                if self._closed:
+                    return
+                key = self._todo.popleft()
+                slot = self._slots.get(key)
+                if slot is not None:
+                    slot["state"] = "loading"
+            if slot is None:           # taken inline or discarded
+                continue
+            try:
+                with metrics.span("exec.prefetch.load", key=str(key)):
+                    slot["result"] = slot["loader"]()
+                _register_staged(slot["result"])
+            except Exception as e:     # delivered to the taker
+                slot["exc"] = e
+            finally:
+                slot["loader"] = None
+                slot["done"].set()

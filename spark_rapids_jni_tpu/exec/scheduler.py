@@ -1,0 +1,320 @@
+"""Bounded-concurrency query scheduler: the serving runtime's front door.
+
+Shape follows the Spark side of the reference stack: a bounded task queue
+feeding a fixed worker pool over ONE shared device, with admission
+control deciding what may touch device memory when (SURVEY §1's
+many-tasks-one-GPU discipline, rebuilt at query granularity).  One
+request's life:
+
+    submit ──queue (priority heap, bounded depth)── dequeue
+      → deadline check → prefetched tables (``exec/prefetch.py``)
+      → admission gate (``exec/admission.py``; may defer or degrade)
+      → plan cache (``exec/plan_cache.py``) under
+        ``memory.budget.query_budget`` + ``faultinj.ResilientExecutor``
+      → ticket resolves (result or typed error)
+
+Everything device-touching happens on the WORKER thread that dequeued
+the request: capture runs, jit traces, and budget scopes are all
+thread-local-safe (``utils.syncs`` tape state and the query-budget stack
+are thread-local by construction), so workers never share partial state.
+
+Backpressure is typed, never silent: a full queue raises
+:class:`~.errors.ExecQueueFull` at submit, a missed deadline resolves
+the ticket with :class:`~.errors.ExecDeadlineExceeded`, shutdown drains
+to :class:`~.errors.ExecShutdown`.  Fault policy rides the shared
+:class:`~..faultinj.resilience.ResilientExecutor`: transient OOMs retry,
+a fatal device fault quarantines the whole pool (fail-fast on every
+later submit) — the plugin's "replace the executor" contract.
+
+Knobs: ``SRJT_EXEC_WORKERS`` (default 4), ``SRJT_EXEC_QUEUE_DEPTH``
+(default 32), plus the admission/prefetch/plan-cache knobs of the
+composed parts.  Histograms: ``exec.queue_wait_ms``,
+``exec.admission_wait_ms``, ``exec.exec_ms``, ``exec.e2e_ms``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import heapq
+import itertools
+import os
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ..faultinj.resilience import DeviceQuarantined, ResilientExecutor
+from ..memory import budget as mbudget
+from ..utils import metrics
+from .admission import AdmissionController, request_bytes
+from .errors import (ExecDeadlineExceeded, ExecError, ExecQueueFull,
+                     ExecShutdown)
+from .plan_cache import PlanCache
+from .prefetch import Prefetcher
+
+
+class QueryTicket:
+    """One submitted request's future: resolves to the query result or a
+    typed error.  ``result()`` blocks; ``timings`` carries the request's
+    queue-wait/admission-wait/exec seconds once resolved."""
+
+    __slots__ = ("name", "_done", "_result", "_exc", "timings", "degraded")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._done = threading.Event()
+        self._result: Any = None
+        self._exc: Optional[BaseException] = None
+        self.timings: dict[str, float] = {}
+        self.degraded = False
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def exception(self) -> Optional[BaseException]:
+        self._done.wait()
+        return self._exc
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.name!r} still pending")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def _resolve(self, result=None, exc: Optional[BaseException] = None):
+        self._result = result
+        self._exc = exc
+        self._done.set()
+
+
+class _Request:
+    __slots__ = ("name", "qfn", "tables", "loader", "priority", "deadline",
+                 "nbytes", "compiled", "ticket", "t_submit", "seq")
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+class QueryScheduler:
+    """Bounded worker pool pulling from a priority request queue.
+
+    Lower ``priority`` values run first (0 = default; ties FIFO by
+    submission order).  Context-manager use shuts the pool down on exit.
+    """
+
+    def __init__(self, workers: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 inflight_bytes=None,
+                 plan_cache: Optional[PlanCache] = None,
+                 prefetch: bool = True,
+                 max_retries: int = 2):
+        if workers is None:
+            workers = int(os.environ.get("SRJT_EXEC_WORKERS", "4"))
+        if queue_depth is None:
+            queue_depth = int(os.environ.get("SRJT_EXEC_QUEUE_DEPTH", "32"))
+        self.workers = max(int(workers), 1)
+        self.queue_depth = max(int(queue_depth), 1)
+        self.admission = AdmissionController(inflight_bytes)
+        self.plans = plan_cache if plan_cache is not None else PlanCache()
+        self.resilient = ResilientExecutor(max_retries=max_retries)
+        self.prefetcher = Prefetcher() if prefetch else None
+        self._heap: list[tuple[int, int, _Request]] = []
+        self._cv = threading.Condition(threading.Lock())
+        self._seq = itertools.count()
+        self._closed = False
+        self._threads = [
+            threading.Thread(target=self._worker, name=f"srjt-exec-{i}",
+                             daemon=True)
+            for i in range(self.workers)]
+        for t in self._threads:
+            t.start()
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, name: str, qfn: Callable, tables=None, *,
+               loader: Optional[Callable[[], Any]] = None,
+               priority: int = 0,
+               timeout_s: Optional[float] = None,
+               nbytes: Optional[int] = None,
+               compiled: bool = True) -> QueryTicket:
+        """Enqueue ``qfn`` over ``tables`` (or over ``loader()``'s result,
+        staged ahead of execution by the prefetcher).  Raises
+        :class:`ExecQueueFull` at depth — the backpressure signal —
+        and :class:`DeviceQuarantined` once the pool is quarantined.
+
+        ``timeout_s`` bounds the request END TO END (queue + admission;
+        a dispatched execution is never aborted mid-flight).  ``nbytes``
+        overrides the admission estimate; ``compiled=False`` bypasses
+        the plan cache (eager execution)."""
+        if tables is None and loader is None:
+            raise ValueError("submit needs tables or a loader")
+        if self.resilient.quarantined:
+            raise DeviceQuarantined("executor is quarantined")
+        ticket = QueryTicket(name)
+        now = time.monotonic()
+        req = _Request(
+            name=name, qfn=qfn, tables=tables, loader=loader,
+            priority=int(priority),
+            deadline=(now + timeout_s) if timeout_s is not None else None,
+            nbytes=nbytes, compiled=compiled, ticket=ticket,
+            t_submit=now, seq=next(self._seq))
+        with self._cv:
+            if self._closed:
+                raise ExecShutdown("scheduler is shut down")
+            if len(self._heap) >= self.queue_depth:
+                if metrics.recording():
+                    metrics.count("exec.queue.rejected")
+                raise ExecQueueFull(self.queue_depth)
+            heapq.heappush(self._heap, (req.priority, req.seq, req))
+            self._cv.notify()
+        if metrics.recording():
+            metrics.count("exec.submitted")
+        if loader is not None and self.prefetcher is not None:
+            # overlap the next request's scan with current executions
+            self.prefetcher.stage((req.name, req.seq), loader)
+        return ticket
+
+    def run(self, name: str, qfn: Callable, tables=None, **kw) -> Any:
+        """Synchronous convenience: submit + block on the result."""
+        return self.submit(name, qfn, tables, **kw).result()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def shutdown(self, wait: bool = True) -> None:
+        """Stop accepting work; queued-but-unstarted requests resolve
+        with :class:`ExecShutdown`.  ``wait`` joins the workers (each
+        finishes its in-flight request first)."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            pending = [r for _, _, r in self._heap]
+            self._heap.clear()
+            self._cv.notify_all()
+        for req in pending:
+            req.ticket._resolve(exc=ExecShutdown(
+                f"scheduler shut down before {req.name!r} started"))
+        self.admission.close()
+        if self.prefetcher is not None:
+            self.prefetcher.close()
+        if wait:
+            for t in self._threads:
+                t.join(timeout=30)
+
+    def __enter__(self) -> "QueryScheduler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # -- worker loop ---------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._heap and not self._closed:
+                    self._cv.wait()
+                if not self._heap:
+                    return              # closed and drained
+                _, _, req = heapq.heappop(self._heap)
+            self._serve(req)
+
+    def _serve(self, req: _Request) -> None:
+        tk = req.ticket
+        t_dq = time.monotonic()
+        queue_wait = t_dq - req.t_submit
+        tk.timings["queue_wait_s"] = queue_wait
+        if metrics.recording():
+            metrics.observe("exec.queue_wait_ms", queue_wait * 1e3)
+        if req.deadline is not None and t_dq > req.deadline:
+            if metrics.recording():
+                metrics.count("exec.deadline.queue")
+            tk._resolve(exc=ExecDeadlineExceeded(
+                req.name, "queue", queue_wait))
+            return
+        try:
+            tables = req.tables
+            if tables is None:
+                tables = self.prefetcher.take((req.name, req.seq),
+                                              req.loader) \
+                    if self.prefetcher is not None else req.loader()
+            est = req.nbytes if req.nbytes is not None \
+                else request_bytes(tables)
+            t_adm = time.monotonic()
+            grant = self.admission.admit(est, name=req.name,
+                                         deadline=req.deadline)
+            adm_wait = time.monotonic() - t_adm
+            tk.timings["admission_wait_s"] = adm_wait
+            if metrics.recording():
+                metrics.observe("exec.admission_wait_ms", adm_wait * 1e3)
+        except ExecError as e:
+            tk._resolve(exc=e)
+            return
+        except BaseException as e:
+            if metrics.recording():
+                metrics.count("exec.failed")
+            tk._resolve(exc=e)
+            return
+        tk.degraded = grant.degrade
+        t0 = time.monotonic()
+        retries0 = self.resilient.retry_count
+        try:
+            with grant:
+                # degraded admission: the dense engine's O(key-range)
+                # lookup table is exactly the allocation that does not
+                # fit — route this request's joins to sort-probe (bit-
+                # identical results, O(n) memory)
+                if grant.degrade:
+                    from ..ops import join_plan
+                    ctx = join_plan.force_engine("sorted")
+                else:
+                    ctx = contextlib.nullcontext()
+                # the full query_budget scope opens a query_span with
+                # live-array HBM censuses — worth it only when the arena
+                # is actually accounting; otherwise a plain span keeps
+                # per-request overhead off the serving hot path
+                scope = mbudget.query_budget(
+                    req.name, queue_wait_ms=round(queue_wait * 1e3, 3),
+                    degraded=grant.degrade) if mbudget.enabled() \
+                    else metrics.span(f"query:{req.name}",
+                                      degraded=grant.degrade)
+                with ctx, scope:
+                    def _run():
+                        if req.compiled:
+                            # degraded plans cache under their own
+                            # variant: a dense-captured tape misaligns
+                            # under the forced sorted engine
+                            return self.plans.run(
+                                req.name, req.qfn, tables,
+                                variant="sorted" if grant.degrade else "")
+                        return req.qfn(tables)
+                    result = self.resilient.submit(_run)
+                    # a response is delivered, not dispatched: JAX
+                    # dispatch is async, so resolve tickets only when
+                    # the result buffers exist (also forces any lazy
+                    # columns while the budget scope is still open)
+                    try:
+                        import jax
+                        result = jax.block_until_ready(result)
+                    except Exception:
+                        pass
+            tk.timings["exec_s"] = time.monotonic() - t0
+            tk.timings["e2e_s"] = time.monotonic() - req.t_submit
+            if metrics.recording():
+                metrics.observe("exec.exec_ms",
+                                tk.timings["exec_s"] * 1e3)
+                metrics.observe("exec.e2e_ms", tk.timings["e2e_s"] * 1e3)
+                metrics.count("exec.completed")
+                retried = self.resilient.retry_count - retries0
+                if retried:
+                    metrics.count("exec.retries", retried)
+            tk._resolve(result=result)
+        except DeviceQuarantined as e:
+            if metrics.recording():
+                metrics.count("exec.quarantined")
+            tk._resolve(exc=e)
+        except BaseException as e:
+            if metrics.recording():
+                metrics.count("exec.failed")
+            tk._resolve(exc=e)

@@ -21,6 +21,8 @@ fault back (or rebuild) on their next touch.
 
 from __future__ import annotations
 
+import threading
+import weakref
 from collections import OrderedDict
 from typing import Callable, Optional
 
@@ -125,7 +127,7 @@ class SpillableArrays:
     copies when spilled (counted as ``arena.faultback.*``); ``spill()``
     moves every array to host and drops the device references."""
 
-    __slots__ = ("tag", "_dev", "_host", "nbytes")
+    __slots__ = ("tag", "_dev", "_host", "nbytes", "_mu")
 
     def __init__(self, tag: str, arrays: dict):
         self.tag = tag
@@ -133,6 +135,7 @@ class SpillableArrays:
         self._host: Optional[dict] = None
         self.nbytes = sum(int(getattr(a, "nbytes", 0) or 0)
                           for a in arrays.values() if a is not None)
+        self._mu = threading.RLock()
 
     @property
     def spilled(self) -> bool:
@@ -140,23 +143,136 @@ class SpillableArrays:
 
     def spill(self) -> int:
         """Device → host; returns bytes released (0 when already host)."""
-        if self._dev is None:
-            return 0
-        self._host = {k: (None if a is None else np.asarray(a))
-                      for k, a in self._dev.items()}
-        self._dev = None
-        return self.nbytes
+        with self._mu:
+            if self._dev is None:
+                return 0
+            self._host = {k: (None if a is None else np.asarray(a))
+                          for k, a in self._dev.items()}
+            self._dev = None
+            return self.nbytes
 
     def get(self) -> dict:
         """The device-array dict, faulting back if spilled."""
-        if self._dev is None:
-            import jax.numpy as jnp
-            with metrics.span("arena.faultback", tag=self.tag,
-                              bytes=self.nbytes):
-                self._dev = {k: (None if a is None else jnp.asarray(a))
-                             for k, a in self._host.items()}
-            self._host = None
-            if metrics.recording():
-                metrics.count("arena.faultback.events")
-                metrics.count("arena.faultback.bytes", self.nbytes)
-        return self._dev
+        with self._mu:
+            if self._dev is None:
+                import jax.numpy as jnp
+                with metrics.span("arena.faultback", tag=self.tag,
+                                  bytes=self.nbytes):
+                    self._dev = {k: (None if a is None else jnp.asarray(a))
+                                 for k, a in self._host.items()}
+                self._host = None
+                if metrics.recording():
+                    metrics.count("arena.faultback.events")
+                    metrics.count("arena.faultback.bytes", self.nbytes)
+            return self._dev
+
+
+class SpillableTable:
+    """In-place host spill for a whole :class:`~..column.Table` (parquet
+    fused-scan outputs, exec-prefetch staged request tables).
+
+    :class:`SpillableArrays` works for payloads whose OWNER re-fetches
+    lanes through ``get()``; a scan-output table is instead held directly
+    by the caller, so eviction must work in place: :meth:`spill` replaces
+    every column's device arrays with their host ``np`` copies (Column
+    payload fields are plain dataclass attributes, and the op library
+    accepts np arrays, re-uploading on next touch) — fault-back is
+    therefore *implicit and bit-exact*: every payload in the engine is an
+    integer/bit-pattern array (FLOAT64 rides as u32 bit pairs), so the
+    host round trip preserves bits on every backend.  Offsets whose host
+    mirror is already promoted into ``utils.hostcache`` spill for free
+    when the mirror's dtype/shape match — the mirror IS the host copy.
+
+    Holds only a weakref to the table: residency must not keep a dead
+    request's working set alive."""
+
+    __slots__ = ("tag", "_ref", "nbytes")
+
+    def __init__(self, table, tag: str, on_death=None):
+        self.tag = tag
+        # the registry's spiller closure keeps THIS object (and so this
+        # weakref + its death callback) alive exactly as long as the
+        # registration itself
+        self._ref = weakref.ref(table, on_death)
+        self.nbytes = table_device_bytes(table)
+
+    def spill(self) -> int:
+        import jax
+
+        from ..utils import hostcache
+        t = self._ref()
+        if t is None:
+            return 0
+        freed = 0
+        for col in _concrete_columns(t):
+            for field in ("data", "offsets", "validity"):
+                a = getattr(col, field, None)
+                if a is None or not isinstance(a, jax.Array):
+                    continue
+                h = hostcache.peek(a)
+                if (h is None or h.dtype != np.dtype(a.dtype)
+                        or h.shape != a.shape):
+                    h = np.asarray(a)
+                setattr(col, field, h)
+                freed += int(a.nbytes)
+        if freed and metrics.recording():
+            metrics.count("arena.spill.table_cols")
+        return freed
+
+
+def _concrete_columns(table):
+    """The table's materialized columns, recursing into children; lazy
+    columns that were never forced hold no device payload and are left
+    untouched (forcing them here would ADD allocations under pressure)."""
+    from ..column import LazyColumn
+    out = []
+    stack = list(table.columns)
+    while stack:
+        c = stack.pop()
+        if isinstance(c, LazyColumn):
+            if c._col is None:
+                continue
+            c = c._col
+        out.append(c)
+        if c.children:
+            stack.extend(c.children)
+    return out
+
+
+def table_device_bytes(table) -> int:
+    """Total bytes of the table's device-resident payload arrays."""
+    import jax
+    total = 0
+    for col in _concrete_columns(table):
+        for field in ("data", "offsets", "validity"):
+            a = getattr(col, field, None)
+            if a is not None and isinstance(a, jax.Array):
+                total += int(a.nbytes)
+    return total
+
+
+def register_table(table, tag: str) -> Optional[SpillableTable]:
+    """Track a caller-held table's device payload as evictable (fused-scan
+    outputs, staged request tables).  The registration dies with the
+    table; a table touched again after spilling re-uploads implicitly and
+    is NOT re-registered (the next scan/stage registers its own).  Returns
+    the handle, or None when the arena is off / nothing is device-resident.
+    """
+    if not budget.active():
+        return None
+    with budget._LOCK:
+        # idempotent per table object: a staged loader's scan output is
+        # already registered — re-registering would double-charge it
+        for r in _reg.values():
+            s = getattr(r.spiller, "__self__", None)
+            if isinstance(s, SpillableTable) and s._ref() is table:
+                return s
+    key = (tag, id(table))
+    try:
+        st = SpillableTable(table, tag, on_death=lambda _: unregister(key))
+    except TypeError:
+        return None
+    if st.nbytes <= 0:
+        return None
+    register(key, st.nbytes, tag, st.spill)
+    return st

@@ -147,3 +147,70 @@ class CompiledQuery:
 def compile_query(qfn: Callable, tables) -> CompiledQuery:
     """Capture ``qfn(tables)`` and return its single-program form."""
     return CompiledQuery(qfn, tables)
+
+
+def plan_key(tables) -> tuple[tuple, list]:
+    """Identity fingerprint of a query's input tables, for plan caching.
+
+    Returns ``(key, arrays)``: a hashable key covering every payload
+    array's ``(id, dtype, shape)`` plus the column/table structure, and
+    the list of keyed arrays so a cache can hold weakrefs guarding the
+    ids against recycling.  Arrays are immutable, so two lookups that
+    produce the SAME key (with all refs live) provably present the same
+    buffers — a plan verified once against them (:meth:`CompiledQuery.run`)
+    may take the unchecked raw-dispatch path on later hits, and refreshed
+    data (new buffers) changes the key instead of silently replaying a
+    stale tape.
+
+    Unforced lazy columns are keyed by identity of the LazyColumn itself,
+    NOT forced: fingerprinting must never materialize device memory.
+    """
+    from ..column import Column, LazyColumn, Table
+    key: list = []
+    arrays: list = []
+
+    def leaf(a):
+        if a is None:
+            key.append(None)
+        else:
+            key.append((id(a), str(getattr(a, "dtype", "?")),
+                        tuple(getattr(a, "shape", ()))))
+            arrays.append(a)
+
+    def col(c):
+        if isinstance(c, LazyColumn) and c._col is not None:
+            c = c._col
+        if isinstance(c, LazyColumn):
+            key.append(("lazy", id(c), c.dtype.id.value, len(c)))
+            arrays.append(c)
+            return
+        key.append(("col", c.dtype.id.value))
+        leaf(c.data)
+        leaf(c.offsets)
+        leaf(c.validity)
+        for ch in (c.children or ()):
+            col(ch)
+
+    def walk(obj):
+        if isinstance(obj, dict):
+            for k in sorted(obj, key=repr):
+                key.append(("key", k))
+                walk(obj[k])
+        elif isinstance(obj, Table):
+            key.append(("table", len(obj.columns)))
+            for c in obj.columns:
+                col(c)
+        elif isinstance(obj, Column):
+            col(obj)
+        elif isinstance(obj, (list, tuple)):
+            key.append(("seq", len(obj)))
+            for v in obj:
+                walk(v)
+        elif isinstance(obj, (int, float, str, bool, bytes, type(None))):
+            key.append(("val", obj))
+        else:
+            key.append(("obj", id(obj)))
+            arrays.append(obj)
+
+    walk(tables)
+    return tuple(key), arrays

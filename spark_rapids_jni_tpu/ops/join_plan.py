@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import weakref
 from collections import OrderedDict
 from typing import NamedTuple, Optional, Sequence
@@ -67,24 +68,31 @@ DENSE_SPAN_FACTOR = 2
 DENSE_SPAN_FLOOR = 4096
 DENSE_SPAN_CAP = 1 << 23
 
-_FORCED: Optional[str] = None      # None | "dense" | "sorted"
+# THREAD-LOCAL: the exec runtime's degraded-admission path pins one
+# request's joins to the low-footprint sorted engine from its worker
+# thread; a process-global flag would leak the degradation into queries
+# running concurrently on other workers.
+_forced_tls = threading.local()    # .kind: None | "dense" | "sorted"
 
 
 def forced_engine() -> Optional[str]:
-    f = _FORCED or os.environ.get("SRJT_JOIN_ENGINE")
+    f = getattr(_forced_tls, "kind", None) \
+        or os.environ.get("SRJT_JOIN_ENGINE")
     return f if f in ("dense", "sorted") else None
 
 
 @contextlib.contextmanager
 def force_engine(kind: Optional[str]):
     """Pin the physical join engine ("dense" / "sorted"; None restores the
-    planner heuristic) — benchmark/test hook, not a production API."""
-    global _FORCED
-    old, _FORCED = _FORCED, kind
+    planner heuristic) for the CURRENT THREAD — benchmark/test hook plus
+    the exec runtime's degraded-admission routing (both engines produce
+    bit-identical indices, so this only trades footprint for speed)."""
+    old = getattr(_forced_tls, "kind", None)
+    _forced_tls.kind = kind
     try:
         yield
     finally:
-        _FORCED = old
+        _forced_tls.kind = old
 
 
 class BuildIndex(NamedTuple):
@@ -117,11 +125,23 @@ class _IndexCache:
       lanes to host RAM, and the next cache hit faults them back.
     * entries die with their key arrays (weakref callbacks) and the cache
       is bypassed under syncs capture/replay, exactly like the old memo.
+
+    Thread-safety: all map/byte-accounting mutation happens under the
+    arena's ``budget._LOCK`` (an RLock).  That lock is deliberately SHARED
+    with ``memory.spill``: the spiller closures below run inside
+    ``spill.reclaim`` — which ``budget.charge`` invokes while holding the
+    lock — so a private cache lock would deadlock ABBA against the
+    register/unregister path.  Weakref death callbacks re-enter safely.
     """
 
     def __init__(self):
         self._d: "OrderedDict[tuple, dict]" = OrderedDict()
         self._device_bytes = 0
+
+    @staticmethod
+    def _lock():
+        from ..memory import budget as mbudget
+        return mbudget._LOCK
 
     @staticmethod
     def _cap() -> Optional[int]:
@@ -130,13 +150,14 @@ class _IndexCache:
             os.environ.get("SRJT_INDEX_CACHE_CAP", "512m"))
 
     def _drop(self, key, *, count_eviction: bool) -> None:
-        e = self._d.pop(key, None)
-        if e is None:
-            return
         from ..memory import spill as mspill
-        if not e["payload"].spilled:
-            self._device_bytes -= e["nbytes"]
-            mspill.unregister(("join_index",) + key)
+        with self._lock():
+            e = self._d.pop(key, None)
+            if e is None:
+                return
+            if not e["payload"].spilled:
+                self._device_bytes -= e["nbytes"]
+                mspill.unregister(("join_index",) + key)
         if count_eviction and metrics.recording():
             metrics.count("join.build_index.evictions")
 
@@ -144,15 +165,18 @@ class _IndexCache:
         if syncs.mode() != "normal":
             return None
         key = (tag,) + tuple(id(a) for a in arrays)
-        e = self._d.get(key)
-        if e is None:
-            return None
-        for r, a in zip(e["refs"], arrays):
-            if r() is not a:
-                return None
-        self._d.move_to_end(key)
         from ..memory import spill as mspill
-        if e["payload"].spilled:
+        with self._lock():
+            e = self._d.get(key)
+            if e is None:
+                return None
+            for r, a in zip(e["refs"], arrays):
+                if r() is not a:
+                    return None
+            self._d.move_to_end(key)
+            if not e["payload"].spilled:
+                mspill.touch(("join_index",) + key)
+                return e["value"]
             lanes = e["payload"].get()          # fault back (bit-exact)
             kind, n_valid, kmin, span, unique = e["meta"]
             e["value"] = BuildIndex(kind, n_valid, lanes["row_ids"],
@@ -165,11 +189,10 @@ class _IndexCache:
             if metrics.recording():
                 metrics.count("join.build_index.faultback")
             self._evict_over_cap(keep=key)
-        else:
-            mspill.touch(("join_index",) + key)
-        return e["value"]
+            return e["value"]
 
     def _evict_over_cap(self, keep=None) -> None:
+        # caller holds the lock
         cap = self._cap()
         if cap is None:
             return
@@ -202,25 +225,31 @@ class _IndexCache:
                           ix.unique)}
 
         def _spiller(e=entry):
-            freed = e["payload"].spill()
-            if freed:
-                e["value"] = None               # drop the device refs
-                self._device_bytes -= e["nbytes"]
-            return freed
+            with self._lock():                  # reentrant under reclaim
+                freed = e["payload"].spill()
+                if freed:
+                    e["value"] = None           # drop the device refs
+                    self._device_bytes -= e["nbytes"]
+                return freed
 
-        self._d[key] = entry
-        self._device_bytes += entry["nbytes"]
-        mspill.register(("join_index",) + key, entry["nbytes"],
-                        "join.build_index", _spiller)
-        self._evict_over_cap(keep=key)
+        with self._lock():
+            # two threads can miss-then-build the same key concurrently;
+            # dropping the loser's entry first keeps the byte ledger exact
+            self._drop(key, count_eviction=False)
+            self._d[key] = entry
+            self._device_bytes += entry["nbytes"]
+            mspill.register(("join_index",) + key, entry["nbytes"],
+                            "join.build_index", _spiller)
+            self._evict_over_cap(keep=key)
 
     def clear(self) -> None:
         from ..memory import spill as mspill
-        for key, e in list(self._d.items()):
-            if not e["payload"].spilled:
-                mspill.unregister(("join_index",) + key)
-        self._d.clear()
-        self._device_bytes = 0
+        with self._lock():
+            for key, e in list(self._d.items()):
+                if not e["payload"].spilled:
+                    mspill.unregister(("join_index",) + key)
+            self._d.clear()
+            self._device_bytes = 0
 
     def device_bytes(self) -> int:
         return self._device_bytes
@@ -383,39 +412,51 @@ class _PlanCache:
     same key buffers returns the same ``KeyPlan`` object and the index
     cache sees the same ``rdata`` buffer.  Bypassed under capture/replay
     for the same reason the index cache is: a memo hit would skip the
-    window ``syncs.scalar`` calls and misalign the tape."""
+    window ``syncs.scalar`` calls and misalign the tape.
+
+    Mutation is guarded by an RLock (reentrant on purpose: a weakref
+    death callback can fire from a GC point inside ``put`` on the same
+    thread that already holds the lock)."""
 
     def __init__(self, cap: int = 8):
         self._d: "OrderedDict[tuple, dict]" = OrderedDict()
         self._cap = cap
+        self._mu = threading.RLock()
+
+    def _evict(self, key) -> None:
+        with self._mu:
+            self._d.pop(key, None)
 
     def get(self, key, arrays) -> Optional["KeyPlan"]:
         if syncs.mode() != "normal":
             return None
-        e = self._d.get(key)
-        if e is None:
-            return None
-        for r, a in zip(e["refs"], arrays):
-            if r() is not a:
+        with self._mu:
+            e = self._d.get(key)
+            if e is None:
                 return None
-        self._d.move_to_end(key)
-        return e["plan"]
+            for r, a in zip(e["refs"], arrays):
+                if r() is not a:
+                    return None
+            self._d.move_to_end(key)
+            return e["plan"]
 
     def put(self, key, arrays, plan: "KeyPlan") -> None:
         if syncs.mode() != "normal":
             return
         try:
             refs = tuple(
-                weakref.ref(a, lambda _, k=key: self._d.pop(k, None))
+                weakref.ref(a, lambda _, k=key: self._evict(k))
                 for a in arrays)
         except TypeError:
             return
-        self._d[key] = {"refs": refs, "plan": plan}
-        while len(self._d) > self._cap:
-            self._d.popitem(last=False)
+        with self._mu:
+            self._d[key] = {"refs": refs, "plan": plan}
+            while len(self._d) > self._cap:
+                self._d.popitem(last=False)
 
     def clear(self) -> None:
-        self._d.clear()
+        with self._mu:
+            self._d.clear()
 
 
 _PLAN_CACHE = _PlanCache()

@@ -915,7 +915,14 @@ def scan_table(file_bytes: bytes,
         host = D.read_table(file_bytes, columns=[names[i] for i in fallback])
         for j, i in enumerate(fallback):
             by_index[i] = host[j]
-    return Table([by_index[i] for i in want])
+    out = Table([by_index[i] for i in want])
+    # fused-scan outputs are evictable residents (HBM-arena follow-on):
+    # under budget pressure the decoded columns host-spill IN PLACE and
+    # fault back bit-exactly on their next op touch (no-op when the arena
+    # is off — register_table gates on budget.active())
+    from ..memory import spill as mspill
+    mspill.register_table(out, "parquet.scan_out")
+    return out
 
 
 # API mirror: callers swap `from ..parquet import decode` for this module

@@ -20,6 +20,7 @@ longer grow host RSS without bound.
 from __future__ import annotations
 
 import os
+import threading
 import weakref
 from collections import OrderedDict
 from typing import Any, Callable, Optional
@@ -41,6 +42,12 @@ class WeakIdMemo:
     turns the memo into a byte-capped LRU over ``value.nbytes``;
     ``on_evict`` fires once per capacity eviction (not for weakref
     deaths).
+
+    Thread-safety: map mutation is guarded by an RLock (reentrant — a
+    weakref death callback can fire at a GC point inside ``put`` on the
+    thread already holding it).  ``on_evict`` callbacks fire AFTER the
+    lock is released so they may take other locks (metrics, arena)
+    without ordering against this one.
     """
 
     def __init__(self, cap_bytes=None,
@@ -49,27 +56,30 @@ class WeakIdMemo:
         self._bytes = 0
         self._cap = cap_bytes
         self._on_evict = on_evict
+        self._mu = threading.RLock()
 
     def _cap_now(self) -> Optional[int]:
         c = self._cap
         return c() if callable(c) else c
 
     def _pop(self, key) -> None:
-        entry = self._d.pop(key, None)
-        if entry is not None:
-            self._bytes -= entry[2]
+        with self._mu:
+            entry = self._d.pop(key, None)
+            if entry is not None:
+                self._bytes -= entry[2]
 
     def get(self, arrays) -> Any:
         key = tuple(id(a) for a in arrays)
-        entry = self._d.get(key)
-        if entry is None:
-            return None
-        refs, value, _ = entry
-        for r, a in zip(refs, arrays):
-            if r() is not a:
+        with self._mu:
+            entry = self._d.get(key)
+            if entry is None:
                 return None
-        self._d.move_to_end(key)
-        return value
+            refs, value, _ = entry
+            for r, a in zip(refs, arrays):
+                if r() is not a:
+                    return None
+            self._d.move_to_end(key)
+            return value
 
     def put(self, arrays, value) -> None:
         key = tuple(id(a) for a in arrays)
@@ -80,18 +90,21 @@ class WeakIdMemo:
         except TypeError:
             return
         nbytes = int(getattr(value, "nbytes", 0) or 0)
-        self._pop(key)
-        self._d[key] = (refs, value, nbytes)
-        self._bytes += nbytes
-        cap = self._cap_now()
-        if cap is None:
-            return
-        while self._bytes > cap and len(self._d) > 1:
-            lru = next(iter(self._d))
-            if lru == key:
-                break
-            self._pop(lru)
-            if self._on_evict is not None:
+        evictions = 0
+        with self._mu:
+            self._pop(key)
+            self._d[key] = (refs, value, nbytes)
+            self._bytes += nbytes
+            cap = self._cap_now()
+            if cap is not None:
+                while self._bytes > cap and len(self._d) > 1:
+                    lru = next(iter(self._d))
+                    if lru == key:
+                        break
+                    self._pop(lru)
+                    evictions += 1
+        if self._on_evict is not None:
+            for _ in range(evictions):
                 self._on_evict()
 
     def nbytes(self) -> int:

@@ -140,6 +140,28 @@ def observe(name: str, value: float) -> None:
         h["buckets"][b] = h["buckets"].get(b, 0) + 1
 
 
+def percentile(name: str, q: float) -> Optional[float]:
+    """Estimate the ``q``-th percentile (0..100) of histogram ``name`` from
+    its log2 buckets: the answer is the upper edge of the bucket holding
+    the quantile, clamped to the observed min/max.  Coarse (≤2× off) but
+    storage-free — serving latency tails (``tools/serve_bench.py``) need
+    the magnitude, not the digit."""
+    with _lock:
+        h = _hists.get(name)
+        if h is None or not h["count"]:
+            return None
+        lo, hi, total = h["min"], h["max"], h["count"]
+        edges = sorted((int(k.rsplit("^", 1)[1]), c)
+                       for k, c in h["buckets"].items())
+    target = total * min(max(q, 0.0), 100.0) / 100.0
+    cum = 0
+    for exp, c in edges:
+        cum += c
+        if cum >= target:
+            return float(min(max(float(1 << exp), lo), hi))
+    return float(hi)
+
+
 # --- span recorder ----------------------------------------------------------
 
 

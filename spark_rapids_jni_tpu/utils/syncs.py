@@ -20,6 +20,7 @@ rows).  Two countermeasures live here:
 from __future__ import annotations
 
 import contextlib
+import threading
 # weakref handled by hostcache.WeakIdMemo
 from typing import Any
 
@@ -36,28 +37,29 @@ _count = 0
 # disable the weak memos so capture and replay visit the SAME sequence of
 # resolution sites (a memo hit in one mode but not the other would
 # misalign the recorded sizes).  See ``models/compiled.py``.
+#
+# The mode and tape are THREAD-LOCAL: a jit trace executes its Python body
+# on the calling thread, so a capture/replay on one exec-runtime worker
+# must not flip the mode (or pop sizes from the tape) of a query running
+# concurrently on another worker.
 
-_mode = "normal"            # "normal" | "capture" | "replay"
-_tape: list[int] = []
-_tape_pos = 0
-_seen: list | None = None   # replay-time collection of the device values
+_tls = threading.local()    # .mode, .tape, .tape_pos, .seen
 
 
 def mode() -> str:
-    return _mode
+    return getattr(_tls, "mode", "normal")
 
 
 @contextlib.contextmanager
 def capture(tape: list[int]):
     """Eager run recording every resolved size into ``tape`` (in order)."""
-    global _mode, _tape
-    if _mode != "normal":
-        raise RuntimeError(f"cannot capture while in {_mode} mode")
-    _mode, _tape = "capture", tape
+    if mode() != "normal":
+        raise RuntimeError(f"cannot capture while in {mode()} mode")
+    _tls.mode, _tls.tape = "capture", tape
     try:
         yield tape
     finally:
-        _mode, _tape = "normal", []
+        _tls.mode, _tls.tape = "normal", []
 
 
 @contextlib.contextmanager
@@ -68,36 +70,37 @@ def replay(tape: list[int], collect: list | None = None):
     :func:`scalar` call (a tracer under jit) in tape order — the raw
     material for a device-side size-vector program that can check a tape
     against refreshed data (``models/compiled.py`` staleness guard)."""
-    global _mode, _tape, _tape_pos, _seen
-    if _mode != "normal":
-        raise RuntimeError(f"cannot replay while in {_mode} mode")
-    _mode, _tape, _tape_pos, _seen = "replay", list(tape), 0, collect
+    if mode() != "normal":
+        raise RuntimeError(f"cannot replay while in {mode()} mode")
+    _tls.mode, _tls.tape, _tls.tape_pos, _tls.seen = \
+        "replay", list(tape), 0, collect
     try:
         yield
-        if _tape_pos != len(_tape):
+        if _tls.tape_pos != len(_tls.tape):
             raise RuntimeError(
-                f"replay consumed {_tape_pos} of {len(_tape)} recorded "
-                "sizes — plan diverged from the capture run")
+                f"replay consumed {_tls.tape_pos} of {len(_tls.tape)} "
+                "recorded sizes — plan diverged from the capture run")
     finally:
-        _mode, _tape, _tape_pos, _seen = "normal", [], 0, None
+        _tls.mode, _tls.tape, _tls.tape_pos, _tls.seen = \
+            "normal", [], 0, None
 
 
 def scalar(x) -> int:
     """int(x) with sync accounting — use for every intentional D2H scalar."""
-    global _count, _tape_pos
-    if _mode == "replay":
-        if _tape_pos >= len(_tape):
+    global _count
+    if mode() == "replay":
+        if _tls.tape_pos >= len(_tls.tape):
             raise RuntimeError(
                 "replay tape exhausted — plan diverged from the capture run")
-        if _seen is not None:
-            _seen.append(x)
-        v = _tape[_tape_pos]
-        _tape_pos += 1
+        if _tls.seen is not None:
+            _tls.seen.append(x)
+        v = _tls.tape[_tls.tape_pos]
+        _tls.tape_pos += 1
         return v
     _count += 1
     v = int(x)
-    if _mode == "capture":
-        _tape.append(v)
+    if mode() == "capture":
+        _tls.tape.append(v)
     return v
 
 
@@ -130,13 +133,13 @@ _MEMOS: dict[str, WeakIdMemo] = {}
 def memo_get(tag: str, arrays) -> Any:
     """Cached value for (tag, arrays) — None on miss or if any array died.
     Disabled under capture/replay (see the mode note above)."""
-    if _mode != "normal":
+    if mode() != "normal":
         return None
     memo = _MEMOS.get(tag)
     return None if memo is None else memo.get(arrays)
 
 
 def memo_put(tag: str, arrays, value) -> None:
-    if _mode != "normal":
+    if mode() != "normal":
         return
     _MEMOS.setdefault(tag, WeakIdMemo()).put(arrays, value)

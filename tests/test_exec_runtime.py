@@ -1,0 +1,533 @@
+"""Serving-runtime (exec/) tests: differential, backpressure, caches.
+
+The exec subsystem's contract is that concurrency, admission
+degradation, plan caching, and prefetch change LATENCY, never results —
+plus a typed failure surface (queue-full, deadline, shutdown,
+quarantine) instead of stalls.  These tests hold all of it:
+
+* concurrent differential — TPC-DS queries served by a 4-worker
+  scheduler, submitted from 4 client threads, bit-identical to serial
+  eager execution; repeated with the HBM arena + a tiny build-index
+  cache so eviction races run under real concurrency.
+* typed backpressure/timeout — ``ExecQueueFull`` at queue depth,
+  ``ExecDeadlineExceeded`` for queued-past-deadline requests,
+  ``ExecShutdown`` for drained requests, quarantine fail-fast.
+* plan cache — hit/miss/eviction/expiry counters, single-flight
+  compilation, degraded-variant key separation.
+* admission — deferred under a mid cap, degraded (sorted engine) under
+  a tiny cap with parity against the dense serial run.
+* thread-safety regressions — the races fixed alongside this subsystem:
+  prefetch stage/take, ``SpillableArrays`` concurrent fault-back,
+  ``WeakIdMemo`` capped put storm, thread-local ``syncs`` capture.
+"""
+
+import gc
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import exec as xc
+from spark_rapids_jni_tpu import types as T
+from spark_rapids_jni_tpu.column import Column, Table
+from spark_rapids_jni_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def _metrics_on():
+    metrics.set_enabled(True)
+    metrics.reset()
+    yield
+    metrics.reset()
+    metrics.set_enabled(None)
+
+
+def _mkcol(vals):
+    return Column(T.DType(T.TypeId.INT32),
+                  jnp.asarray(np.asarray(vals, np.int32)))
+
+
+def _mktab(n, seed):
+    rng = np.random.default_rng(seed)
+    return Table([_mkcol(rng.integers(0, 100, n)),
+                  _mkcol(rng.integers(0, 7, n))])
+
+
+def _q_sum(tbls):
+    t = tbls["t"]
+    return Table([Column(T.DType(T.TypeId.INT64),
+                         jnp.sum(t.columns[0].data.astype(jnp.int64))
+                         .reshape(1))])
+
+
+def _canon(result):
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(result)]
+
+
+def _same(a, b):
+    return len(a) == len(b) and all(
+        x.shape == y.shape and np.array_equal(x, y)
+        for x, y in zip(a, b))
+
+
+# --- TPC-DS differential -----------------------------------------------------
+
+
+QNAMES = ["q3", "q42", "q55"]
+
+
+@pytest.fixture(scope="module")
+def tpcds_tables():
+    from benchmarks import tpcds_data
+    from spark_rapids_jni_tpu.models import tpcds
+    files = tpcds_data.generate(n_sales=20_000, n_items=500, n_stores=6,
+                                seed=7)
+    return tpcds.load_tables(files)
+
+
+def _serve_mix(tables, oracle, **sched_kw):
+    """Submit each query 4x from 4 client threads; return mismatch count
+    and the tickets."""
+    from spark_rapids_jni_tpu.models import tpcds
+    mix = [(i, q) for i in range(4) for q in QNAMES]
+    tickets = {}
+    errs = []
+    with xc.QueryScheduler(workers=4, **sched_kw) as sched:
+        def client(i):
+            try:
+                for j, q in mix:
+                    if j == i:
+                        tickets[(i, q)] = sched.submit(
+                            q, tpcds.QUERIES[q], tables)
+            except Exception as e:       # surfaced to the test
+                errs.append(e)
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs, errs
+        bad = sum(not _same(_canon(tk.result(timeout=300)), oracle[q])
+                  for (_, q), tk in tickets.items())
+    return bad, list(tickets.values())
+
+
+def test_concurrent_differential(tpcds_tables):
+    from spark_rapids_jni_tpu.models import tpcds
+    oracle = {q: _canon(tpcds.QUERIES[q](tpcds_tables)) for q in QNAMES}
+    bad, _ = _serve_mix(tpcds_tables, oracle)
+    assert bad == 0
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("exec.completed", 0) == 12
+    # 3 distinct (query, fingerprint) keys; the other 9 requests hit
+    assert snap.get("exec.plan_cache.miss", 0) == 3
+    assert snap.get("exec.plan_cache.hit", 0) == 9
+
+
+def test_concurrent_differential_arena_evictions(tpcds_tables):
+    """Same differential with the arena on and a build-index cache so
+    small every concurrent join evicts its neighbor — the eviction-race
+    surface (shared budget lock, spill registry) under real load."""
+    from spark_rapids_jni_tpu.memory import budget, spill
+    from spark_rapids_jni_tpu.models import tpcds
+    from spark_rapids_jni_tpu.ops import join_plan
+    oracle = {q: _canon(tpcds.QUERIES[q](tpcds_tables)) for q in QNAMES}
+    saved = {k: os.environ.get(k)
+             for k in ("SRJT_HBM_ARENA", "SRJT_INDEX_CACHE_CAP")}
+    os.environ["SRJT_HBM_ARENA"] = "1"
+    os.environ["SRJT_INDEX_CACHE_CAP"] = "4k"
+    budget.set_enabled(None)
+    join_plan._INDEX_CACHE.clear()
+    spill.reset()
+    budget.reset()
+    try:
+        # eager (compiled=False): the index cache is live only outside
+        # capture/replay, so eager serving is what races on it
+        from functools import partial
+        mix = [(i, q) for i in range(4) for q in QNAMES]
+        tickets = []
+        with xc.QueryScheduler(workers=4) as sched:
+            for _, q in mix:
+                tickets.append(
+                    (q, sched.submit(q, tpcds.QUERIES[q], tpcds_tables,
+                                     compiled=False)))
+            bad = sum(not _same(_canon(tk.result(timeout=300)), oracle[q])
+                      for q, tk in tickets)
+        assert bad == 0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        budget.set_enabled(None)
+        join_plan._INDEX_CACHE.clear()
+        spill.reset()
+        budget.reset()
+
+
+def test_degraded_admission_parity(tpcds_tables):
+    """A cap every request exceeds: all requests degrade to the sorted
+    engine, complete, and match the dense serial oracle bit-for-bit."""
+    from spark_rapids_jni_tpu.models import tpcds
+    oracle = {q: _canon(tpcds.QUERIES[q](tpcds_tables)) for q in QNAMES}
+    tickets = []
+    with xc.QueryScheduler(workers=2, inflight_bytes=4096) as sched:
+        for q in QNAMES:
+            tickets.append((q, sched.submit(q, tpcds.QUERIES[q],
+                                            tpcds_tables, compiled=False)))
+        for q, tk in tickets:
+            assert _same(_canon(tk.result(timeout=300)), oracle[q]), q
+            assert tk.degraded
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("exec.admission.degraded", 0) >= 3
+    assert snap.get("exec.failed", 0) == 0
+
+
+# --- backpressure / deadlines / lifecycle ------------------------------------
+
+
+def _q_slow(tbls):
+    time.sleep(0.1)
+    return _q_sum(tbls)
+
+
+def test_queue_full_typed():
+    tables = {"t": _mktab(100, 0)}
+    with xc.QueryScheduler(workers=1, queue_depth=2) as sched:
+        held, full = [], 0
+        for _ in range(10):
+            try:
+                held.append(sched.submit("s", _q_slow, tables,
+                                         compiled=False))
+            except xc.ExecQueueFull as e:
+                full += 1
+                assert e.depth == 2
+        assert full >= 1
+        for tk in held:
+            tk.result(timeout=60)
+    assert metrics.snapshot()["counters"].get("exec.queue.rejected") == full
+
+
+def test_deadline_in_queue_typed():
+    tables = {"t": _mktab(100, 0)}
+    with xc.QueryScheduler(workers=1, queue_depth=4) as sched:
+        blocker = sched.submit("s", _q_slow, tables, compiled=False)
+        tk = sched.submit("dl", _q_slow, tables, compiled=False,
+                          timeout_s=0.001)
+        with pytest.raises(xc.ExecDeadlineExceeded) as ei:
+            tk.result(timeout=60)
+        assert ei.value.stage == "queue"
+        blocker.result(timeout=60)
+
+
+def test_shutdown_drains_typed():
+    tables = {"t": _mktab(100, 0)}
+    sched = xc.QueryScheduler(workers=1, queue_depth=8)
+    held = [sched.submit("s", _q_slow, tables, compiled=False)
+            for _ in range(5)]
+    sched.shutdown(wait=True)
+    outcomes = []
+    for tk in held:
+        try:
+            tk.result(timeout=10)
+            outcomes.append("ok")
+        except xc.ExecShutdown:
+            outcomes.append("shutdown")
+    assert "shutdown" in outcomes          # queued requests drained
+    with pytest.raises(xc.ExecShutdown):
+        sched.submit("late", _q_slow, tables)
+
+
+def test_quarantine_fail_fast():
+    from spark_rapids_jni_tpu.faultinj.injector import InjectedDeviceError
+    from spark_rapids_jni_tpu.faultinj.resilience import DeviceQuarantined
+    tables = {"t": _mktab(100, 0)}
+
+    def q_fatal(tbls):
+        raise InjectedDeviceError("ptx trap analog")
+
+    with xc.QueryScheduler(workers=1) as sched:
+        tk = sched.submit("fatal", q_fatal, tables, compiled=False)
+        with pytest.raises(DeviceQuarantined):
+            tk.result(timeout=60)
+        # fail-fast on every later submit — the replace-the-executor
+        # contract
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                sched.submit("after", _q_sum, tables, compiled=False)
+            except DeviceQuarantined:
+                break
+            time.sleep(0.01)
+        else:
+            pytest.fail("quarantine did not fail fast")
+    assert metrics.snapshot()["counters"].get("exec.quarantined", 0) >= 1
+
+
+def test_transient_oom_retries():
+    from spark_rapids_jni_tpu.faultinj.injector import InjectedOomError
+    tables = {"t": _mktab(100, 0)}
+    state = {"n": 0}
+
+    def q_flaky(tbls):
+        state["n"] += 1
+        if state["n"] == 1:
+            raise InjectedOomError("transient")
+        return _q_sum(tbls)
+
+    with xc.QueryScheduler(workers=1) as sched:
+        out = sched.run("flaky", q_flaky, tables, compiled=False)
+    assert int(np.asarray(out.columns[0].data)[0]) == int(
+        np.asarray(_q_sum(tables).columns[0].data)[0])
+    assert metrics.snapshot()["counters"].get("exec.retries", 0) >= 1
+
+
+# --- admission ----------------------------------------------------------------
+
+
+def test_admission_deferred_then_serves():
+    tables = {"t": _mktab(5000, 3)}
+    est = xc.request_bytes(tables)
+    assert est > 0
+    oracle = _canon(_q_sum(tables))
+    with xc.QueryScheduler(workers=4,
+                           inflight_bytes=int(est * 1.5)) as sched:
+        tks = [sched.submit(f"q{i}", _q_slow, tables, compiled=False)
+               for i in range(4)]
+        for tk in tks:
+            assert _same(_canon(tk.result(timeout=60)), oracle)
+            assert not tk.degraded       # fits the cap → dense path
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("exec.admission.deferred", 0) >= 1
+    assert snap.get("exec.admission.degraded", 0) == 0
+
+
+def test_admission_deadline_typed():
+    ctl = xc.AdmissionController(cap_bytes=1000)
+    grant = ctl.admit(800, name="hold")
+    with pytest.raises(xc.ExecDeadlineExceeded):
+        ctl.admit(500, name="late",
+                  deadline=time.monotonic() + 0.05)
+    grant.release()
+    with ctl.admit(500, name="now") as g:
+        assert not g.degrade
+
+
+# --- plan cache ---------------------------------------------------------------
+
+
+def test_plan_cache_hit_and_counters():
+    tables = {"t": _mktab(1000, 1)}
+    cache = xc.PlanCache(cap=4)
+    a = _canon(cache.run("s", _q_sum, tables))
+    b = _canon(cache.run("s", _q_sum, tables))
+    c = _canon(cache.run("s", _q_sum, tables))
+    assert _same(a, b) and _same(b, c)
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("exec.plan_cache.miss") == 1
+    assert snap.get("exec.plan_cache.hit") == 2
+    # second hit runs the verified raw-dispatch path
+    assert snap.get("compiled.replay_run", 0) >= 1
+
+
+def test_plan_cache_eviction_capacity():
+    cache = xc.PlanCache(cap=1)
+    t1 = {"t": _mktab(500, 1)}
+    t2 = {"t": _mktab(500, 2)}
+    cache.run("s", _q_sum, t1)
+    cache.run("s", _q_sum, t2)              # evicts t1's entry
+    assert len(cache) == 1
+    cache.run("s", _q_sum, t1)              # miss again
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("exec.plan_cache.evictions", 0) >= 2
+    assert snap.get("exec.plan_cache.miss") == 3
+    assert not snap.get("exec.plan_cache.hit")
+
+
+def test_plan_cache_expiry_on_gc():
+    cache = xc.PlanCache(cap=4)
+    tables = {"t": _mktab(500, 4)}
+    cache.run("s", _q_sum, tables)
+    assert len(cache) == 1
+    del tables
+    gc.collect()
+    assert len(cache) == 0                  # weakref death evicted it
+
+
+def test_plan_cache_refreshed_data_recaptures():
+    """New buffers (same shapes) must be a new key → fresh capture, and
+    both datasets' results stay correct."""
+    cache = xc.PlanCache(cap=4)
+    t1 = {"t": _mktab(800, 5)}
+    t2 = {"t": _mktab(800, 6)}              # same shape, different data
+    a1 = _canon(cache.run("s", _q_sum, t1))
+    a2 = _canon(cache.run("s", _q_sum, t2))
+    assert _same(a1, _canon(_q_sum(t1)))
+    assert _same(a2, _canon(_q_sum(t2)))
+    assert not _same(a1, a2)
+    assert metrics.snapshot()["counters"].get("exec.plan_cache.miss") == 2
+
+
+def test_plan_cache_single_flight():
+    tables = {"t": _mktab(2000, 7)}
+    cache = xc.PlanCache(cap=4)
+    barrier = threading.Barrier(4)
+    outs, errs = [], []
+
+    def worker():
+        try:
+            barrier.wait(timeout=30)
+            outs.append(_canon(cache.run("s", _q_sum, tables)))
+        except Exception as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    assert all(_same(outs[0], o) for o in outs[1:])
+    # one capture total: racing misses coalesced onto one build
+    assert metrics.snapshot()["counters"].get("exec.plan_cache.miss") == 1
+
+
+# --- prefetch -----------------------------------------------------------------
+
+
+def test_prefetch_hit_and_inline_miss():
+    pf = xc.Prefetcher(depth=2)
+    try:
+        assert pf.stage("a", lambda: {"t": _mktab(200, 8)})
+        assert pf._slots["a"]["done"].wait(30)   # staged, not racing take
+        got = pf.take("a")
+        assert _same(_canon(_q_sum(got)), _canon(_q_sum({"t": _mktab(200, 8)})))
+        got = pf.take("nope", loader=lambda: {"t": _mktab(100, 9)})
+        assert got["t"].columns[0].data.shape[0] == 100
+    finally:
+        pf.close()
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("exec.prefetch.hit") == 1
+    assert snap.get("exec.prefetch.miss") == 1
+
+
+def test_prefetch_take_before_stage_race():
+    """Regression: take() claiming a still-queued slot must load inline
+    instead of waiting for a staging pass that will never run."""
+    pf = xc.Prefetcher(depth=2)
+    try:
+        for i in range(50):
+            pf.stage(i, lambda i=i: i * 2)
+            t0 = time.monotonic()
+            assert pf.take(i, loader=lambda i=i: i * 2) == i * 2
+            assert time.monotonic() - t0 < 5
+    finally:
+        pf.close()
+
+
+def test_prefetch_depth_bound():
+    pf = xc.Prefetcher(depth=1)
+    try:
+        ev = threading.Event()
+        assert pf.stage("slow", lambda: (ev.wait(10), 1)[1])
+        assert not pf.stage("b", lambda: 2)      # buffer full → rejected
+        ev.set()
+        assert pf.take("slow") == 1
+    finally:
+        pf.close()
+    assert metrics.snapshot()["counters"].get("exec.prefetch.rejected") == 1
+
+
+# --- thread-safety regressions ------------------------------------------------
+
+
+def test_spillable_arrays_concurrent_faultback():
+    """Two threads racing get() on a spilled payload must both see the
+    device arrays (the _host=None race fixed with this subsystem)."""
+    from spark_rapids_jni_tpu.memory.spill import SpillableArrays
+    data = np.arange(4096, dtype=np.int32)
+    for _ in range(20):
+        sa = SpillableArrays("t", {"d": jnp.asarray(data)})
+        assert sa.spill() > 0
+        outs, errs = [], []
+
+        def reader():
+            try:
+                outs.append(np.asarray(sa.get()["d"]))
+            except Exception as e:
+                errs.append(e)
+        ts = [threading.Thread(target=reader) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert not errs, errs
+        assert all(np.array_equal(o, data) for o in outs)
+
+
+def test_weakidmemo_concurrent_capped_puts():
+    from spark_rapids_jni_tpu.utils.hostcache import WeakIdMemo
+    evictions = []
+    memo = WeakIdMemo(cap_bytes=64 * 100,
+                      on_evict=lambda: evictions.append(1))
+    keys = [np.zeros(1, np.int8) for _ in range(200)]   # weakref-able keys
+    errs = []
+
+    def writer(lo):
+        try:
+            for i in range(lo, lo + 50):
+                memo.put((keys[i],), np.zeros(64, np.uint8))
+                memo.get((keys[i],))
+        except Exception as e:
+            errs.append(e)
+
+    ts = [threading.Thread(target=writer, args=(i * 50,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not errs, errs
+    assert memo.nbytes() <= 64 * 100 + 64    # cap respected (±1 in flight)
+    assert evictions                         # capped storm did evict
+
+
+def test_syncs_capture_is_thread_local():
+    """Two threads capturing concurrently must record onto their own
+    tapes (a process-global mode would interleave them)."""
+    from spark_rapids_jni_tpu.utils import syncs
+    results = {}
+    errs = []
+
+    def run(tid, vals):
+        try:
+            tape = []
+            with syncs.capture(tape):
+                for v in vals:
+                    syncs.scalar(jnp.asarray(v, jnp.int32))
+            results[tid] = tape
+        except Exception as e:
+            errs.append(e)
+
+    t1 = threading.Thread(target=run, args=(1, [11, 12, 13] * 20))
+    t2 = threading.Thread(target=run, args=(2, [27, 28] * 30))
+    t1.start(); t2.start()
+    t1.join(timeout=30); t2.join(timeout=30)
+    assert not errs, errs
+    assert results[1] == [11, 12, 13] * 20
+    assert results[2] == [27, 28] * 30
+
+
+def test_exec_enabled_gate(monkeypatch):
+    monkeypatch.delenv("SRJT_EXEC", raising=False)
+    assert not xc.enabled()
+    monkeypatch.setenv("SRJT_EXEC", "1")
+    assert xc.enabled()
+    monkeypatch.setenv("SRJT_EXEC", "off")
+    assert not xc.enabled()

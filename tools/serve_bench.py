@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Serving-runtime throughput bench → SERVE_BENCH.json.
+
+Measures the question the ``exec/`` subsystem exists to answer: how many
+QUERIES PER SECOND does this engine serve over a TPC-DS query mix, and
+what does a request wait on?  Three configurations over the same request
+stream (round-robin over the chosen queries):
+
+  serial_eager    — one request at a time, eager execution: the engine
+                    WITHOUT the serving runtime (no plan reuse, ~30
+                    dispatches + size syncs per request).
+  serial_compiled — one at a time through a warm plan cache: isolates
+                    the plan-cache contribution from concurrency.
+  concurrent      — the full runtime: ``QueryScheduler`` with N workers
+                    (``SRJT_SERVE_WORKERS``, default 4), warm plan
+                    cache, admission on.  XLA executions release the
+                    GIL, so worker overlap is real compute overlap.
+
+Every response in every configuration is checked BIT-IDENTICAL to the
+serial eager oracle — concurrency and caching must never change results.
+A final degraded phase re-runs the mix under a deliberately tiny
+``SRJT_EXEC_INFLIGHT_BYTES`` cap: every request over-caps, admission
+degrades them to the sorted join engine (exclusive admission), and the
+bench asserts completion with correct results — the "pressure never
+fails a servable request" contract, measured.
+
+Latency detail comes from the runtime's own histograms
+(``exec.queue_wait_ms`` / ``exec.e2e_ms`` p50/p95 via
+``metrics.percentile``) — the numbers a capacity plan needs.
+
+Usage: python tools/serve_bench.py [n_sales] [out.json] [q1,q2,...] [requests]
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+
+
+def canon(result):
+    """A result pytree as host arrays (forces lazy columns)."""
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(result)]
+
+
+def identical(a, b) -> bool:
+    return len(a) == len(b) and all(
+        x.shape == y.shape and np.array_equal(x, y) for x, y in zip(a, b))
+
+
+def main():
+    n_sales = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+    out_path = sys.argv[2] if len(sys.argv) > 2 else "SERVE_BENCH.json"
+    qnames = (sys.argv[3].split(",") if len(sys.argv) > 3
+              else ["q3", "q42", "q52", "q55"])
+    n_requests = int(sys.argv[4]) if len(sys.argv) > 4 else 32
+    import os
+    workers = int(os.environ.get("SRJT_SERVE_WORKERS", "4"))
+
+    from benchmarks import tpcds_data
+    from spark_rapids_jni_tpu import exec as xc
+    from spark_rapids_jni_tpu.models import tpcds
+    from spark_rapids_jni_tpu.utils import metrics
+
+    metrics.set_enabled(True)   # the wait histograms ARE the deliverable
+
+    print(f"backend: {jax.default_backend()}  n_sales: {n_sales}  "
+          f"mix: {qnames}  requests: {n_requests}  workers: {workers}",
+          flush=True)
+    files = tpcds_data.generate(n_sales=n_sales, n_items=2000,
+                                n_stores=12, seed=5)
+    tables = tpcds.load_tables(files)
+    for c in tables["store_sales"].columns:
+        np.asarray(c.data[:1])          # force fact upload out of band
+
+    mix = [(f"req{i}", qnames[i % len(qnames)]) for i in range(n_requests)]
+    results = {"n_sales": n_sales, "queries": qnames,
+               "requests": n_requests, "workers": workers}
+
+    # oracle + serial eager timing in one pass
+    oracle = {}
+    t0 = time.perf_counter()
+    for _, q in mix:
+        out = canon(tpcds.QUERIES[q](tables))
+        oracle.setdefault(q, out)
+    serial_s = time.perf_counter() - t0
+    results["serial_eager"] = {
+        "wall_s": round(serial_s, 3),
+        "qps": round(n_requests / serial_s, 2)}
+    print(f"serial eager:    {n_requests / serial_s:7.2f} qps", flush=True)
+
+    plans = xc.PlanCache()
+    for q in qnames:                    # warm the cache out of band
+        jax.block_until_ready(plans.run(q, tpcds.QUERIES[q], tables))
+        jax.block_until_ready(plans.run(q, tpcds.QUERIES[q], tables))
+
+    t0 = time.perf_counter()
+    serial_out = [canon(plans.run(q, tpcds.QUERIES[q], tables))
+                  for _, q in mix]
+    sc_s = time.perf_counter() - t0
+    assert all(identical(out, oracle[q]) for out, (_, q) in
+               zip(serial_out, mix)), "serial compiled diverged"
+    results["serial_compiled"] = {
+        "wall_s": round(sc_s, 3), "qps": round(n_requests / sc_s, 2)}
+    print(f"serial compiled: {n_requests / sc_s:7.2f} qps", flush=True)
+
+    with xc.QueryScheduler(workers=workers, plan_cache=plans) as sched:
+        t0 = time.perf_counter()
+        tickets = [sched.submit(q, tpcds.QUERIES[q], tables)
+                   for _, q in mix]
+        outs = [tk.result(timeout=600) for tk in tickets]
+        conc_s = time.perf_counter() - t0
+    bad = sum(not identical(canon(out), oracle[q])
+              for out, (_, q) in zip(outs, mix))
+    assert bad == 0, f"{bad} concurrent responses diverged from serial"
+    results["concurrent"] = {
+        "wall_s": round(conc_s, 3),
+        "qps": round(n_requests / conc_s, 2),
+        "speedup_vs_serial": round(serial_s / conc_s, 2),
+        "speedup_vs_serial_compiled": round(sc_s / conc_s, 2),
+        "queue_wait_ms": {
+            "p50": metrics.percentile("exec.queue_wait_ms", 50),
+            "p95": metrics.percentile("exec.queue_wait_ms", 95)},
+        "e2e_ms": {
+            "p50": metrics.percentile("exec.e2e_ms", 50),
+            "p95": metrics.percentile("exec.e2e_ms", 95)},
+        "responses_identical": True}
+    print(f"concurrent:      {n_requests / conc_s:7.2f} qps "
+          f"({serial_s / conc_s:.1f}x serial eager, "
+          f"{sc_s / conc_s:.1f}x serial compiled)", flush=True)
+
+    # degraded phase: every request over-caps the in-flight ledger →
+    # exclusive admission on the sorted engine; must complete, bit-exact
+    with xc.QueryScheduler(workers=workers, inflight_bytes=4096) as dsched:
+        t0 = time.perf_counter()
+        tickets = [dsched.submit(q, tpcds.QUERIES[q], tables)
+                   for _, q in mix]
+        outs = [tk.result(timeout=600) for tk in tickets]
+        deg_s = time.perf_counter() - t0
+        degraded = sum(tk.degraded for tk in tickets)
+    bad = sum(not identical(canon(out), oracle[q])
+              for out, (_, q) in zip(outs, mix))
+    assert bad == 0, f"{bad} degraded responses diverged from serial"
+    assert degraded > 0, "tight cap should have degraded requests"
+    results["degraded"] = {
+        "wall_s": round(deg_s, 3),
+        "qps": round(n_requests / deg_s, 2),
+        "degraded_requests": int(degraded),
+        "responses_identical": True}
+    print(f"degraded (4 KiB cap): {n_requests / deg_s:6.2f} qps, "
+          f"{degraded}/{n_requests} degraded, all identical", flush=True)
+
+    snap = metrics.snapshot()["counters"]
+    results["counters"] = {k: v for k, v in sorted(snap.items())
+                           if k.startswith(("exec.", "compiled.",
+                                            "join.engine."))}
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"wrote {out_path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
