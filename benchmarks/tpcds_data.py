@@ -11,6 +11,7 @@ representation, ``RowConversion.java:114-118``).
 
 from __future__ import annotations
 
+import functools
 import io
 
 import numpy as np
@@ -28,9 +29,14 @@ def _parquet(table: pa.Table) -> bytes:
     return buf.getvalue()
 
 
+@functools.lru_cache(maxsize=8)
 def generate(n_sales: int = 100_000, n_items: int = 2000,
              n_dates: int = 366 * 3, n_stores: int = 12,
              seed: int = 42) -> dict[str, bytes]:
+    # memoized: generation is pure in its arguments, and several test
+    # modules ask for identical datasets — returning the SAME byte blobs
+    # lets the decode layer's identity memo skip re-scanning them.
+    # Callers must treat the returned dict as read-only.
     rng = np.random.default_rng(seed)
 
     import decimal as _dec

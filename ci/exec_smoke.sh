@@ -6,7 +6,9 @@
 # deadline errors surface instead of stalls, (3) a tight
 # SRJT_EXEC_INFLIGHT_BYTES cap completes the whole mix via degraded
 # admission (sorted join engine) with ≥1 exec.admission.degraded counted
-# and zero wrong results.  Artifacts land in target/exec_smoke/.
+# and zero wrong results, (4) a same-plan burst coalesces into batched
+# launches (≥1 exec.batch.size sample ≥2) with responses still
+# bit-identical.  Artifacts land in target/exec_smoke/.
 #
 # Usage: ci/exec_smoke.sh [n_sales] [queries]
 set -euo pipefail
@@ -109,6 +111,29 @@ assert wrong == 0, f"{wrong} degraded responses wrong"
 assert snap.get("exec.admission.degraded", 0) >= 1, snap
 print(f"degraded OK: {int(snap['exec.admission.degraded'])} degraded "
       f"admissions, 0 wrong results")
+
+# 4) cross-request coalescing: a same-plan burst behind a slow blocker
+# batches into shared launches, responses bit-identical to serial
+metrics.reset()
+q0 = qnames[0]
+with xc.QueryScheduler(workers=2, coalesce_ms=100) as bsched:
+    blocker = [bsched.submit("blocker", slow, tables, compiled=False)
+               for _ in range(2)]          # occupy both workers
+    tickets = [bsched.submit(q0, tpcds.QUERIES[q0], tables)
+               for _ in range(8)]
+    for b in blocker:
+        b.result(timeout=300)
+    wrong = sum(
+        not all(np.array_equal(a, b) for a, b in
+                zip(canon(tk.result(timeout=300)), oracle[q0]))
+        for tk in tickets)
+snap = metrics.snapshot()
+assert wrong == 0, f"{wrong} batched responses wrong"
+bh = snap["histograms"].get("exec.batch.size")
+assert bh is not None and bh["max"] >= 2, \
+    f"burst did not coalesce: {bh}"
+print(f"batched OK: {int(bh['count'])} batched launches, "
+      f"max batch {int(bh['max'])}, 0 wrong results")
 
 with open(os.path.join(out, "summary.json"), "w") as f:
     json.dump(metrics.summary(), f, indent=1)

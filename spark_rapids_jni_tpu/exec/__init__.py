@@ -8,13 +8,17 @@ Parts, each its own module:
 
 * :mod:`.scheduler` — bounded worker pool + priority queue, typed
   backpressure, deadlines (``SRJT_EXEC_WORKERS``,
-  ``SRJT_EXEC_QUEUE_DEPTH``).
+  ``SRJT_EXEC_QUEUE_DEPTH``), and cross-request coalescing: same-plan
+  requests batch into ONE program launch (``SRJT_EXEC_COALESCE_MS``,
+  ``SRJT_EXEC_COALESCE_MAX``), bit-identical to serial execution.
 * :mod:`.admission` — per-request HBM gate with graceful degradation
   (``SRJT_EXEC_INFLIGHT_BYTES``): defer under pressure, force the
   memory-lean sorted join engine when a request can never fit dense.
 * :mod:`.plan_cache` — LRU of compiled (capture/replay) plans keyed on
   (query, input fingerprint) so the warm loop is one dispatch per
-  request (``SRJT_EXEC_PLAN_CACHE_CAP``).
+  request (``SRJT_EXEC_PLAN_CACHE_CAP``), with size-fingerprint plan
+  sharing across refreshed same-shape data
+  (``SRJT_EXEC_PLAN_SIZE_FP``) and vmapped batch execution.
 * :mod:`.prefetch` — double-buffered staging overlapping the next
   request's scan with current execution (``SRJT_EXEC_PREFETCH_DEPTH``).
 

@@ -44,14 +44,21 @@ from ..utils import metrics
 from .errors import ExecDeadlineExceeded, ExecShutdown
 
 
-def request_bytes(tables) -> int:
+def request_bytes(tables, seen: Optional[set] = None) -> int:
     """Byte estimate for one request's input working set: every payload
     array (device- or host-resident — a spilled input re-uploads on first
     touch, so it counts) across the request's tables.  Inputs dominate
     the footprint lower bound; op transients ride the per-site budget
-    charges after admission."""
+    charges after admission.
+
+    ``seen`` (a set of array ids) carries dedup state ACROSS calls: a
+    coalesced batch charges each shared buffer once — N requests over the
+    same resident tables cost the ledger one working set, not N — while
+    distinct buffers accumulate, which is what the scheduler's greedy
+    cap-split walks."""
     total = 0
-    seen: set[int] = set()
+    if seen is None:
+        seen = set()
 
     def add(a):
         nonlocal total

@@ -16,13 +16,22 @@ before anything the running request holds.  On ``take`` the registration
 is dropped: from that instant the table is a running plan's working set,
 which the spill registry must never touch.
 
-Counters: ``exec.prefetch.{hit,miss,rejected}``.
+Slots are deadline-aware: ``stage`` records the request's deadline, and
+a staged table whose request already exceeded it frees its slot instead
+of occupying double-buffer capacity — swept when a new ``stage`` finds
+the buffer full, and skipped by the staging loop before loading
+(``exec.prefetch.deadline_evicted``).  A dead request's tables are the
+one thing the double buffer must never hold while a live request loads
+inline.
+
+Counters: ``exec.prefetch.{hit,miss,rejected,deadline_evicted}``.
 """
 
 from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict, deque
 from typing import Callable, Optional
 
@@ -74,23 +83,46 @@ class Prefetcher:
             target=self._loop, name="srjt-exec-prefetch", daemon=True)
         self._thread.start()
 
-    def stage(self, key, loader: Callable[[], object]) -> bool:
+    def stage(self, key, loader: Callable[[], object],
+              deadline: Optional[float] = None) -> bool:
         """Queue ``loader`` to run on the staging thread.  False (with
         ``exec.prefetch.rejected``) when the buffer is full or the key is
         already staged — the caller's ``take`` then loads inline, which
-        is the correct degraded behavior, not an error."""
+        is the correct degraded behavior, not an error.
+
+        ``deadline`` is the request's absolute ``time.monotonic()``
+        deadline: once it passes, the slot is reclaimable — a full buffer
+        evicts expired slots before rejecting the newcomer."""
         with self._cv:
             if self._closed or key in self._slots:
                 return False
+            if len(self._slots) >= self.depth:
+                self._evict_expired_locked()
             if len(self._slots) >= self.depth:
                 if metrics.recording():
                     metrics.count("exec.prefetch.rejected")
                 return False
             self._slots[key] = {"state": "queued", "done": threading.Event(),
-                                "result": None, "exc": None, "loader": loader}
+                                "result": None, "exc": None, "loader": loader,
+                                "deadline": deadline}
             self._todo.append(key)
             self._cv.notify_all()
         return True
+
+    def _evict_expired_locked(self) -> None:
+        """Free every slot whose request's deadline has passed (called
+        with the lock held).  Loading slots stay — the staging thread
+        owns them mid-flight; they are swept once done."""
+        now = time.monotonic()
+        for k, slot in list(self._slots.items()):
+            dl = slot.get("deadline")
+            if dl is None or now <= dl or slot["state"] == "loading":
+                continue
+            self._slots.pop(k)
+            if slot["done"].is_set() and slot["exc"] is None:
+                _unregister_staged(slot["result"])
+            if metrics.recording():
+                metrics.count("exec.prefetch.deadline_evicted")
 
     def take(self, key, loader: Optional[Callable[[], object]] = None):
         """The staged working set for ``key`` (blocks until staged), or
@@ -153,6 +185,14 @@ class Prefetcher:
                     return
                 key = self._todo.popleft()
                 slot = self._slots.get(key)
+                if slot is not None and slot.get("deadline") is not None \
+                        and time.monotonic() > slot["deadline"]:
+                    # the request is already dead: don't spend the
+                    # staging thread (or a slot) loading for it
+                    self._slots.pop(key, None)
+                    if metrics.recording():
+                        metrics.count("exec.prefetch.deadline_evicted")
+                    slot = None
                 if slot is not None:
                     slot["state"] = "loading"
             if slot is None:           # taken inline or discarded

@@ -26,10 +26,29 @@ to :class:`~.errors.ExecShutdown`.  Fault policy rides the shared
 a fatal device fault quarantines the whole pool (fail-fast on every
 later submit) — the plugin's "replace the executor" contract.
 
+**Cross-request coalescing** (``SRJT_EXEC_COALESCE_MS``, default 4 ms;
+0 disables): workers don't just interleave same-plan requests, they
+COALESCE them into one program launch — the paper's few-large-programs
+discipline applied across requests instead of across rows.  A dequeued
+compiled request first sweeps the queue for requests with the same
+coalesce key (query name + qfn + size fingerprint of the tables), then
+holds a short window — bounded by every gathered request's deadline —
+for more arrivals, and the whole batch executes through
+``PlanCache.run_batched``: identical buffers share one dispatch and its
+result, distinct same-shape buffers stack onto the plan's vmapped
+program.  Admission charges the batch ONCE (shared buffers dedup in the
+estimate); a batch whose combined footprint would blow the in-flight cap
+splits greedily into cap-sized sub-batches (``exec.batch.split``).
+Results are bit-identical to serial execution by construction — the
+batched paths are parity-checked, and every fallback is the ordinary
+per-request dispatch.
+
 Knobs: ``SRJT_EXEC_WORKERS`` (default 4), ``SRJT_EXEC_QUEUE_DEPTH``
-(default 32), plus the admission/prefetch/plan-cache knobs of the
-composed parts.  Histograms: ``exec.queue_wait_ms``,
-``exec.admission_wait_ms``, ``exec.exec_ms``, ``exec.e2e_ms``.
+(default 32), ``SRJT_EXEC_COALESCE_MS`` (default 4),
+``SRJT_EXEC_COALESCE_MAX`` (default 16), plus the admission/prefetch/
+plan-cache knobs of the composed parts.  Histograms:
+``exec.queue_wait_ms``, ``exec.admission_wait_ms``, ``exec.exec_ms``,
+``exec.e2e_ms``, ``exec.batch.size``, ``exec.batch.coalesce_wait_ms``.
 """
 
 from __future__ import annotations
@@ -44,6 +63,7 @@ from typing import Any, Callable, Optional
 
 from ..faultinj.resilience import DeviceQuarantined, ResilientExecutor
 from ..memory import budget as mbudget
+from ..models import compiled as C
 from ..utils import metrics
 from .admission import AdmissionController, request_bytes
 from .errors import (ExecDeadlineExceeded, ExecError, ExecQueueFull,
@@ -89,7 +109,7 @@ class QueryTicket:
 
 class _Request:
     __slots__ = ("name", "qfn", "tables", "loader", "priority", "deadline",
-                 "nbytes", "compiled", "ticket", "t_submit", "seq")
+                 "nbytes", "compiled", "ticket", "t_submit", "seq", "ckey")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -108,13 +128,21 @@ class QueryScheduler:
                  inflight_bytes=None,
                  plan_cache: Optional[PlanCache] = None,
                  prefetch: bool = True,
-                 max_retries: int = 2):
+                 max_retries: int = 2,
+                 coalesce_ms: Optional[float] = None,
+                 max_batch: Optional[int] = None):
         if workers is None:
             workers = int(os.environ.get("SRJT_EXEC_WORKERS", "4"))
         if queue_depth is None:
             queue_depth = int(os.environ.get("SRJT_EXEC_QUEUE_DEPTH", "32"))
+        if coalesce_ms is None:
+            coalesce_ms = float(os.environ.get("SRJT_EXEC_COALESCE_MS", "4"))
+        if max_batch is None:
+            max_batch = int(os.environ.get("SRJT_EXEC_COALESCE_MAX", "16"))
         self.workers = max(int(workers), 1)
         self.queue_depth = max(int(queue_depth), 1)
+        self.coalesce_ms = max(float(coalesce_ms), 0.0)
+        self.max_batch = max(int(max_batch), 1)
         self.admission = AdmissionController(inflight_bytes)
         self.plans = plan_cache if plan_cache is not None else PlanCache()
         self.resilient = ResilientExecutor(max_retries=max_retries)
@@ -153,12 +181,22 @@ class QueryScheduler:
             raise DeviceQuarantined("executor is quarantined")
         ticket = QueryTicket(name)
         now = time.monotonic()
+        ckey = None
+        if compiled and tables is not None and self.coalesce_ms > 0:
+            # coalesce key: same query + same plan shape ⇒ same compiled
+            # program ⇒ batchable into one launch.  Size (not identity)
+            # fingerprint, so refreshed same-shape data coalesces too.
+            try:
+                sfp, _ = C.plan_key(tables, by_size=True)
+                ckey = (name, id(qfn), sfp)
+            except Exception:
+                ckey = None
         req = _Request(
             name=name, qfn=qfn, tables=tables, loader=loader,
             priority=int(priority),
             deadline=(now + timeout_s) if timeout_s is not None else None,
             nbytes=nbytes, compiled=compiled, ticket=ticket,
-            t_submit=now, seq=next(self._seq))
+            t_submit=now, seq=next(self._seq), ckey=ckey)
         with self._cv:
             if self._closed:
                 raise ExecShutdown("scheduler is shut down")
@@ -167,12 +205,15 @@ class QueryScheduler:
                     metrics.count("exec.queue.rejected")
                 raise ExecQueueFull(self.queue_depth)
             heapq.heappush(self._heap, (req.priority, req.seq, req))
-            self._cv.notify()
+            # notify_all: idle workers AND workers holding a coalesce
+            # window open both need the arrival signal
+            self._cv.notify_all()
         if metrics.recording():
             metrics.count("exec.submitted")
         if loader is not None and self.prefetcher is not None:
             # overlap the next request's scan with current executions
-            self.prefetcher.stage((req.name, req.seq), loader)
+            self.prefetcher.stage((req.name, req.seq), loader,
+                                  deadline=req.deadline)
         return ticket
 
     def run(self, name: str, qfn: Callable, tables=None, **kw) -> Any:
@@ -218,18 +259,220 @@ class QueryScheduler:
                 if not self._heap:
                     return              # closed and drained
                 _, _, req = heapq.heappop(self._heap)
-            self._serve(req)
+                batch = [req]
+                if req.ckey is not None:
+                    self._gather_locked(req.ckey, batch)
+            if req.ckey is not None:
+                self._coalesce_wait(req.ckey, batch)
+            if len(batch) == 1:
+                self._serve(req)
+            else:
+                self._serve_batch(batch)
+
+    # -- coalescing ----------------------------------------------------------
+
+    def _gather_locked(self, ckey, batch: list) -> None:
+        """Pull every queued request with coalesce key ``ckey`` out of the
+        heap into ``batch`` (up to ``max_batch``).  Caller holds the CV
+        lock."""
+        room = self.max_batch - len(batch)
+        if room <= 0 or not self._heap:
+            return
+        keep, take = [], []
+        for item in self._heap:
+            if room > 0 and item[2].ckey == ckey:
+                take.append(item[2])
+                room -= 1
+            else:
+                keep.append(item)
+        if take:
+            self._heap[:] = keep
+            heapq.heapify(self._heap)
+            take.sort(key=lambda r: (r.priority, r.seq))
+            batch.extend(take)
+
+    def _coalesce_wait(self, ckey, batch: list) -> None:
+        """Hold a short window for more same-plan arrivals.  The window is
+        bounded by ``coalesce_ms`` AND by every gathered request's
+        deadline — coalescing must never be the thing that kills a
+        servable request."""
+        t0 = time.monotonic()
+        t_end = t0 + self.coalesce_ms / 1e3
+
+        def _bound(reqs):
+            nonlocal t_end
+            for r in reqs:
+                if r.deadline is not None:
+                    t_end = min(t_end, r.deadline)
+        _bound(batch)
+        while len(batch) < self.max_batch and not self._closed:
+            now = time.monotonic()
+            if now >= t_end:
+                break
+            with self._cv:
+                self._cv.wait(timeout=t_end - now)
+                n0 = len(batch)
+                self._gather_locked(ckey, batch)
+            _bound(batch[n0:])
+        if metrics.recording():
+            metrics.observe("exec.batch.coalesce_wait_ms",
+                            (time.monotonic() - t0) * 1e3)
+
+    def _split_by_cap(self, reqs: list) -> list:
+        """Greedily pack ``reqs`` into sub-batches whose combined unique
+        input bytes fit the in-flight cap.  Shared buffers count once per
+        sub-batch (the estimate is the batch's true working set, not
+        N× it); a request that alone exceeds the cap stays a singleton
+        and takes the ordinary degraded-admission path."""
+        cap = self.admission.cap
+        if cap is None:
+            return [(reqs, 0)]
+        subs: list = []
+        cur, seen, total = [], set(), 0
+        for r in reqs:
+            est = r.nbytes if r.nbytes is not None \
+                else request_bytes(r.tables, seen=seen)
+            if cur and total + est > cap:
+                subs.append((cur, total))
+                cur, seen, total = [], set(), 0
+                est = r.nbytes if r.nbytes is not None \
+                    else request_bytes(r.tables, seen=seen)
+            cur.append(r)
+            total += est
+        subs.append((cur, total))
+        if len(subs) > 1 and metrics.recording():
+            metrics.count("exec.batch.split", len(subs) - 1)
+        return subs
+
+    def _serve_batch(self, batch: list) -> None:
+        """Serve a coalesced same-plan batch: per-request deadline sweep,
+        one admission charge per cap-fitting sub-batch, one program
+        launch through ``PlanCache.run_batched``."""
+        now = time.monotonic()
+        live = []
+        for r in batch:
+            qw = now - r.t_submit
+            r.ticket.timings["queue_wait_s"] = qw
+            if metrics.recording():
+                metrics.observe("exec.queue_wait_ms", qw * 1e3)
+            if r.deadline is not None and now > r.deadline:
+                if metrics.recording():
+                    metrics.count("exec.deadline.queue")
+                if self.prefetcher is not None and r.loader is not None:
+                    self.prefetcher.discard((r.name, r.seq))
+                r.ticket._resolve(exc=ExecDeadlineExceeded(
+                    r.name, "queue", qw))
+            else:
+                live.append(r)
+        for sub, est in self._split_by_cap(live):
+            if len(sub) == 1:
+                self._serve(sub[0])
+            elif sub:
+                self._execute_batch(sub, est)
+
+    def _execute_batch(self, batch: list, est: int) -> None:
+        name = batch[0].name
+        deadlines = [r.deadline for r in batch if r.deadline is not None]
+        try:
+            t_adm = time.monotonic()
+            grant = self.admission.admit(
+                est, name=f"{name}[x{len(batch)}]",
+                deadline=min(deadlines) if deadlines else None)
+            adm_wait = time.monotonic() - t_adm
+            for r in batch:
+                r.ticket.timings["admission_wait_s"] = adm_wait
+            if metrics.recording():
+                metrics.observe("exec.admission_wait_ms", adm_wait * 1e3)
+        except ExecDeadlineExceeded:
+            # only the earliest deadline is binding: resolve the expired
+            # members, serve the survivors individually (each re-admits
+            # under its own deadline)
+            now = time.monotonic()
+            for r in batch:
+                if r.deadline is not None and now > r.deadline:
+                    if metrics.recording():
+                        metrics.count("exec.admission.deadline")
+                    r.ticket._resolve(exc=ExecDeadlineExceeded(
+                        r.name, "admission", now - r.t_submit))
+                else:
+                    self._serve(r)
+            return
+        except ExecError as e:
+            for r in batch:
+                r.ticket._resolve(exc=e)
+            return
+        except BaseException as e:
+            if metrics.recording():
+                metrics.count("exec.failed")
+            for r in batch:
+                r.ticket._resolve(exc=e)
+            return
+        if grant.degrade:
+            # a multi-request sub-batch always fits the cap by
+            # construction; defensive fallback only
+            grant.release()
+            for r in batch:
+                self._serve(r)
+            return
+        t0 = time.monotonic()
+        retries0 = self.resilient.retry_count
+        try:
+            with grant:
+                scope = mbudget.query_budget(
+                    name, batched=len(batch)) if mbudget.enabled() \
+                    else metrics.span(f"query:{name}", batched=len(batch))
+                with scope, metrics.span("batch", size=len(batch)):
+                    def _run():
+                        return self.plans.run_batched(
+                            name, batch[0].qfn,
+                            [r.tables for r in batch])
+                    outs = self.resilient.submit(_run)
+                    try:
+                        import jax
+                        outs = jax.block_until_ready(outs)
+                    except Exception:
+                        pass
+            dt = time.monotonic() - t0
+            if metrics.recording():
+                metrics.observe("exec.batch.size", len(batch))
+                retried = self.resilient.retry_count - retries0
+                if retried:
+                    metrics.count("exec.retries", retried)
+            t_done = time.monotonic()
+            for r, out in zip(batch, outs):
+                r.ticket.timings["exec_s"] = dt
+                r.ticket.timings["e2e_s"] = t_done - r.t_submit
+                if metrics.recording():
+                    metrics.observe("exec.exec_ms", dt * 1e3)
+                    metrics.observe("exec.e2e_ms",
+                                    (t_done - r.t_submit) * 1e3)
+                    metrics.count("exec.completed")
+                r.ticket._resolve(result=out)
+        except DeviceQuarantined as e:
+            if metrics.recording():
+                metrics.count("exec.quarantined")
+            for r in batch:
+                r.ticket._resolve(exc=e)
+        except BaseException as e:
+            if metrics.recording():
+                metrics.count("exec.failed")
+            for r in batch:
+                r.ticket._resolve(exc=e)
 
     def _serve(self, req: _Request) -> None:
         tk = req.ticket
         t_dq = time.monotonic()
         queue_wait = t_dq - req.t_submit
-        tk.timings["queue_wait_s"] = queue_wait
-        if metrics.recording():
-            metrics.observe("exec.queue_wait_ms", queue_wait * 1e3)
+        if "queue_wait_s" not in tk.timings:    # batch sweeps record it
+            tk.timings["queue_wait_s"] = queue_wait
+            if metrics.recording():
+                metrics.observe("exec.queue_wait_ms", queue_wait * 1e3)
         if req.deadline is not None and t_dq > req.deadline:
             if metrics.recording():
                 metrics.count("exec.deadline.queue")
+            if self.prefetcher is not None and req.loader is not None:
+                # a dead request's staged tables must not occupy a slot
+                self.prefetcher.discard((req.name, req.seq))
             tk._resolve(exc=ExecDeadlineExceeded(
                 req.name, "queue", queue_wait))
             return

@@ -38,7 +38,8 @@ memo is disabled under capture/replay so tapes stay aligned.)
 
 from __future__ import annotations
 
-from typing import Any, Callable
+import threading
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -90,7 +91,17 @@ class CompiledQuery:
             with syncs.replay(list(self.tape)):
                 return _materialized(qfn(tbls))
         _traced.__name__ = f"compiled_{qname}"
+        self._traced_fn = _traced
         self._prog = jax.jit(_traced)
+
+        # batched (vmapped) variant, built lazily on first cross-request
+        # batch (exec/plan_cache.py run_batched): None = not yet probed,
+        # True = parity-verified, False = rejected (trace failure or a
+        # parity mismatch) — once False the plan never batches again
+        self._vlock = threading.Lock()
+        self._vprog = None
+        self._vtreedef = None
+        self._batchable: Optional[bool] = None
 
         def _sizes(tbls):
             seen: list = []
@@ -139,6 +150,83 @@ class CompiledQuery:
         with metrics.span(f"compiled.run_unchecked:{self.name}"):
             return self._prog(tables)
 
+    def run_vmapped(self, tables_list) -> Optional[list]:
+        """Execute K same-shaped table sets as ONE vmapped dispatch of the
+        compiled tape: array leaves stack on a leading batch axis,
+        non-array leaves (static config values, equal across the batch by
+        the size fingerprint that grouped it) ride as closure constants,
+        and the per-element body is exactly :attr:`_traced_fn` — the same
+        replay the serial program runs, so every recorded size stays
+        static under ``jax.vmap``.
+
+        Returns the K per-element results (unstacked), or ``None`` when
+        the caller must fall back to per-request dispatch: mismatched
+        structures/shapes within the batch (transient — the batch was
+        mis-grouped), a failed vmap trace, or a failed parity probe (both
+        permanent for this plan).
+
+        Bit-exactness is enforced, not assumed: the first batched run
+        re-executes element 0 through the serial program and compares
+        every output buffer byte-for-byte (``compiled.batch_parity_check``);
+        a mismatch rejects batching for this plan forever
+        (``compiled.batch_parity_reject``) rather than ever serving a
+        response that differs from serial execution."""
+        if self._batchable is False:
+            return None
+        try:
+            flat = [jax.tree_util.tree_flatten(t) for t in tables_list]
+            leaves0, treedef = flat[0]
+            is_arr = [hasattr(l, "dtype") and hasattr(l, "shape")
+                      for l in leaves0]
+            if any(td != treedef or len(ls) != len(leaves0)
+                   for ls, td in flat[1:]):
+                return None
+            stacked = [jnp.stack([ls[i] for ls, _ in flat])
+                       for i, a in enumerate(is_arr) if a]
+        except Exception:
+            return None             # shape skew within the batch: fall back
+        with self._vlock:
+            if self._vprog is None:
+                consts = [l for l, a in zip(leaves0, is_arr) if not a]
+
+                def _elem(arrs, _c=tuple(consts), _ia=tuple(is_arr),
+                          _td=treedef):
+                    ai, ci = iter(arrs), iter(_c)
+                    full = [next(ai) if a else next(ci) for a in _ia]
+                    return self._traced_fn(
+                        jax.tree_util.tree_unflatten(_td, full))
+                self._vtreedef = treedef
+                self._vprog = jax.jit(jax.vmap(_elem))
+            elif self._vtreedef != treedef:
+                return None
+        try:
+            with metrics.span(f"compiled.batch:{self.name}",
+                              size=len(tables_list)):
+                out = self._vprog(stacked)
+            metrics.count("compiled.batch_replay")
+        except Exception:
+            metrics.count("compiled.batch_unsupported")
+            self._batchable = False
+            return None
+        outs = [jax.tree_util.tree_map(lambda l, _i=i: l[_i], out)
+                for i in range(len(tables_list))]
+        if self._batchable is None:
+            metrics.count("compiled.batch_parity_check")
+            ref = jax.tree_util.tree_leaves(
+                self.run_unchecked(tables_list[0]))
+            got = jax.tree_util.tree_leaves(outs[0])
+
+            def _bits(a):
+                a = np.ascontiguousarray(np.asarray(a))
+                return (a.dtype.str, a.shape, a.tobytes())
+            if len(ref) != len(got) or any(
+                    _bits(r) != _bits(g) for r, g in zip(ref, got)):
+                metrics.count("compiled.batch_parity_reject")
+                self._batchable = False
+                return None
+            self._batchable = True
+        return outs
+
     def lower_text(self, tables) -> str:
         """StableHLO of the whole-query program (diagnostics)."""
         return self._prog.lower(tables).as_text()
@@ -149,21 +237,33 @@ def compile_query(qfn: Callable, tables) -> CompiledQuery:
     return CompiledQuery(qfn, tables)
 
 
-def plan_key(tables) -> tuple[tuple, list]:
-    """Identity fingerprint of a query's input tables, for plan caching.
+def plan_key(tables, *, by_size: bool = False) -> tuple[tuple, list]:
+    """Fingerprint of a query's input tables, for plan caching.
 
-    Returns ``(key, arrays)``: a hashable key covering every payload
-    array's ``(id, dtype, shape)`` plus the column/table structure, and
-    the list of keyed arrays so a cache can hold weakrefs guarding the
-    ids against recycling.  Arrays are immutable, so two lookups that
+    Returns ``(key, arrays)``: a hashable key plus the list of keyed
+    arrays so a cache can hold weakrefs guarding ids against recycling.
+
+    **Identity mode** (default): every payload array keys on
+    ``(id, dtype, shape)``.  Arrays are immutable, so two lookups that
     produce the SAME key (with all refs live) provably present the same
     buffers — a plan verified once against them (:meth:`CompiledQuery.run`)
     may take the unchecked raw-dispatch path on later hits, and refreshed
     data (new buffers) changes the key instead of silently replaying a
     stale tape.
 
-    Unforced lazy columns are keyed by identity of the LazyColumn itself,
-    NOT forced: fingerprinting must never materialize device memory.
+    **Size mode** (``by_size=True``): arrays key on ``(dtype, shape)``
+    only — the *shape* of the request, not its buffers.  Two requests
+    with equal size keys trace to the same XLA program, so a warm plan
+    can be shared across refreshed same-shape data — PROVIDED the tape is
+    revalidated on first replay against the new buffers (the resolved
+    sizes, e.g. join cardinalities, are data- not shape-determined; the
+    checked :meth:`CompiledQuery.run` is that revalidation).  Objects the
+    walker cannot see inside (the ``obj`` arm) still key by identity in
+    size mode: sharing across unknown state is never safe.
+
+    Unforced lazy columns are keyed by identity (size mode: dtype +
+    length) of the LazyColumn itself, NOT forced: fingerprinting must
+    never materialize device memory.
     """
     from ..column import Column, LazyColumn, Table
     key: list = []
@@ -173,15 +273,22 @@ def plan_key(tables) -> tuple[tuple, list]:
         if a is None:
             key.append(None)
         else:
-            key.append((id(a), str(getattr(a, "dtype", "?")),
-                        tuple(getattr(a, "shape", ()))))
+            if by_size:
+                key.append((str(getattr(a, "dtype", "?")),
+                            tuple(getattr(a, "shape", ()))))
+            else:
+                key.append((id(a), str(getattr(a, "dtype", "?")),
+                            tuple(getattr(a, "shape", ()))))
             arrays.append(a)
 
     def col(c):
         if isinstance(c, LazyColumn) and c._col is not None:
             c = c._col
         if isinstance(c, LazyColumn):
-            key.append(("lazy", id(c), c.dtype.id.value, len(c)))
+            if by_size:
+                key.append(("lazy", c.dtype.id.value, len(c)))
+            else:
+                key.append(("lazy", id(c), c.dtype.id.value, len(c)))
             arrays.append(c)
             return
         key.append(("col", c.dtype.id.value))

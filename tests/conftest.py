@@ -21,6 +21,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent executable cache (same dir tools/query_bench.py uses): the
+# per-module clear_caches below drops live executables to bound XLA:CPU
+# memory, so heavyweight programs (capture/replay traces, fused scans,
+# the mortgage ETL) recompile once per module — with the disk cache those
+# recompiles deserialize instead, keyed on HLO, across modules AND runs.
+jax.config.update("jax_compilation_cache_dir", ".jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 import gc

@@ -83,11 +83,19 @@ QNAMES = ["q3", "q42", "q55"]
 
 @pytest.fixture(scope="module")
 def tpcds_tables():
+    # same parameters as test_compiled_query's dataset: generate() is
+    # memoized, so this module rides that module's decoded tables
+    # instead of paying a second cold scan
     from benchmarks import tpcds_data
     from spark_rapids_jni_tpu.models import tpcds
-    files = tpcds_data.generate(n_sales=20_000, n_items=500, n_stores=6,
-                                seed=7)
+    files = tpcds_data.generate(n_sales=20_000, n_items=300, seed=11)
     return tpcds.load_tables(files)
+
+
+@pytest.fixture(scope="module")
+def tpcds_oracle(tpcds_tables):
+    from spark_rapids_jni_tpu.models import tpcds
+    return {q: _canon(tpcds.QUERIES[q](tpcds_tables)) for q in QNAMES}
 
 
 def _serve_mix(tables, oracle, **sched_kw):
@@ -118,10 +126,8 @@ def _serve_mix(tables, oracle, **sched_kw):
     return bad, list(tickets.values())
 
 
-def test_concurrent_differential(tpcds_tables):
-    from spark_rapids_jni_tpu.models import tpcds
-    oracle = {q: _canon(tpcds.QUERIES[q](tpcds_tables)) for q in QNAMES}
-    bad, _ = _serve_mix(tpcds_tables, oracle)
+def test_concurrent_differential(tpcds_tables, tpcds_oracle):
+    bad, _ = _serve_mix(tpcds_tables, tpcds_oracle)
     assert bad == 0
     snap = metrics.snapshot()["counters"]
     assert snap.get("exec.completed", 0) == 12
@@ -130,14 +136,14 @@ def test_concurrent_differential(tpcds_tables):
     assert snap.get("exec.plan_cache.hit", 0) == 9
 
 
-def test_concurrent_differential_arena_evictions(tpcds_tables):
+def test_concurrent_differential_arena_evictions(tpcds_tables, tpcds_oracle):
     """Same differential with the arena on and a build-index cache so
     small every concurrent join evicts its neighbor — the eviction-race
     surface (shared budget lock, spill registry) under real load."""
     from spark_rapids_jni_tpu.memory import budget, spill
     from spark_rapids_jni_tpu.models import tpcds
     from spark_rapids_jni_tpu.ops import join_plan
-    oracle = {q: _canon(tpcds.QUERIES[q](tpcds_tables)) for q in QNAMES}
+    oracle = tpcds_oracle
     saved = {k: os.environ.get(k)
              for k in ("SRJT_HBM_ARENA", "SRJT_INDEX_CACHE_CAP")}
     os.environ["SRJT_HBM_ARENA"] = "1"
@@ -172,11 +178,11 @@ def test_concurrent_differential_arena_evictions(tpcds_tables):
         budget.reset()
 
 
-def test_degraded_admission_parity(tpcds_tables):
+def test_degraded_admission_parity(tpcds_tables, tpcds_oracle):
     """A cap every request exceeds: all requests degrade to the sorted
     engine, complete, and match the dense serial oracle bit-for-bit."""
     from spark_rapids_jni_tpu.models import tpcds
-    oracle = {q: _canon(tpcds.QUERIES[q](tpcds_tables)) for q in QNAMES}
+    oracle = tpcds_oracle
     tickets = []
     with xc.QueryScheduler(workers=2, inflight_bytes=4096) as sched:
         for q in QNAMES:
@@ -341,14 +347,34 @@ def test_plan_cache_eviction_capacity():
     cache = xc.PlanCache(cap=1)
     t1 = {"t": _mktab(500, 1)}
     t2 = {"t": _mktab(500, 2)}
-    cache.run("s", _q_sum, t1)
-    cache.run("s", _q_sum, t2)              # evicts t1's entry
+    a1 = _canon(cache.run("s", _q_sum, t1))
+    a2 = _canon(cache.run("s", _q_sum, t2))      # evicts t1's entry
     assert len(cache) == 1
-    cache.run("s", _q_sum, t1)              # miss again
+    b1 = _canon(cache.run("s", _q_sum, t1))      # identity miss again
+    assert _same(a1, b1) and _same(a1, _canon(_q_sum(t1)))
+    assert _same(a2, _canon(_q_sum(t2)))
     snap = metrics.snapshot()["counters"]
     assert snap.get("exec.plan_cache.evictions", 0) >= 2
-    assert snap.get("exec.plan_cache.miss") == 3
+    # same shape: one capture, the evicted re-entries adopt the warm
+    # plan through the size-fingerprint index and revalidate
+    assert snap.get("exec.plan_cache.miss") == 1
+    assert snap.get("exec.plan_cache.size_hit") == 2
+    assert snap.get("exec.plan_cache.revalidate") == 2
     assert not snap.get("exec.plan_cache.hit")
+
+
+def test_plan_cache_eviction_capacity_no_size_sharing():
+    """With size-fingerprint sharing off, refreshed buffers recapture —
+    the pre-sharing contract stays available behind the knob."""
+    cache = xc.PlanCache(cap=1, share_by_size=False)
+    t1 = {"t": _mktab(500, 1)}
+    t2 = {"t": _mktab(500, 2)}
+    cache.run("s", _q_sum, t1)
+    cache.run("s", _q_sum, t2)
+    cache.run("s", _q_sum, t1)
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("exec.plan_cache.miss") == 3
+    assert not snap.get("exec.plan_cache.size_hit")
 
 
 def test_plan_cache_expiry_on_gc():
@@ -361,9 +387,10 @@ def test_plan_cache_expiry_on_gc():
     assert len(cache) == 0                  # weakref death evicted it
 
 
-def test_plan_cache_refreshed_data_recaptures():
-    """New buffers (same shapes) must be a new key → fresh capture, and
-    both datasets' results stay correct."""
+def test_plan_cache_refreshed_data_size_fp_hit():
+    """Refreshed buffers (same shapes, new data) adopt the warm plan via
+    the size fingerprint — ONE capture, the adopter revalidated against
+    its own tape — and both datasets' results stay correct."""
     cache = xc.PlanCache(cap=4)
     t1 = {"t": _mktab(800, 5)}
     t2 = {"t": _mktab(800, 6)}              # same shape, different data
@@ -372,7 +399,37 @@ def test_plan_cache_refreshed_data_recaptures():
     assert _same(a1, _canon(_q_sum(t1)))
     assert _same(a2, _canon(_q_sum(t2)))
     assert not _same(a1, a2)
-    assert metrics.snapshot()["counters"].get("exec.plan_cache.miss") == 2
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("exec.plan_cache.miss") == 1
+    assert snap.get("exec.plan_cache.size_hit") == 1
+    assert snap.get("exec.plan_cache.revalidate") == 1
+    assert len(cache) == 2                  # distinct identity entries
+
+
+def test_plan_cache_size_fp_stale_tape_recompiles():
+    """A data-DEPENDENT size defeats the shape fingerprint: the adopted
+    plan's tape revalidation must catch the mismatch (StaleTapeError)
+    and recapture rather than return wrong-shaped results."""
+    from spark_rapids_jni_tpu.utils import syncs
+
+    def q_dyn(tbls):
+        d = tbls["t"].columns[0].data
+        n = syncs.scalar(jnp.sum((d > 50).astype(jnp.int32)))
+        return Table([Column(T.DType(T.TypeId.INT32),
+                             jnp.arange(n, dtype=jnp.int32))])
+
+    cache = xc.PlanCache(cap=4)
+    rng = np.random.default_rng(0)
+    t1 = {"t": Table([_mkcol(rng.integers(0, 100, 600))])}
+    t2 = {"t": Table([_mkcol(rng.integers(0, 100, 600))])}  # same shape
+    a1 = _canon(cache.run("dyn", q_dyn, t1))
+    a2 = _canon(cache.run("dyn", q_dyn, t2))
+    assert _same(a1, _canon(q_dyn(t1)))
+    assert _same(a2, _canon(q_dyn(t2)))
+    assert a1[0].shape != a2[0].shape       # sizes really diverged
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("exec.plan_cache.size_hit") == 1
+    assert snap.get("exec.plan_cache.stale", 0) >= 1
 
 
 def test_plan_cache_single_flight():
@@ -397,6 +454,122 @@ def test_plan_cache_single_flight():
     assert all(_same(outs[0], o) for o in outs[1:])
     # one capture total: racing misses coalesced onto one build
     assert metrics.snapshot()["counters"].get("exec.plan_cache.miss") == 1
+
+
+# --- cross-request coalescing -------------------------------------------------
+
+
+def _burst(sched, reqs):
+    """Submit behind a slow eager blocker so the requests pile up in the
+    queue and the dequeuing worker can coalesce them."""
+    blocker = sched.submit("blocker", _q_slow, {"t": _mktab(50, 99)},
+                           compiled=False)
+    tks = [sched.submit(name, qfn, tbls, **kw)
+           for name, qfn, tbls, kw in reqs]
+    return blocker, tks
+
+
+def test_coalesced_burst_bit_identical(tpcds_tables, tpcds_oracle):
+    """A burst of same-plan TPC-DS requests coalesces into batched
+    launches and every response stays bit-identical to serial eager."""
+    from spark_rapids_jni_tpu.models import tpcds
+    oracle = tpcds_oracle
+    reqs = [(q, tpcds.QUERIES[q], tpcds_tables, {})
+            for q in QNAMES for _ in range(4)]
+    with xc.QueryScheduler(workers=1, coalesce_ms=100) as sched:
+        blocker, tks = _burst(sched, reqs)
+        blocker.result(timeout=60)
+        bad = sum(not _same(_canon(tk.result(timeout=300)), oracle[q])
+                  for (q, _, _, _), tk in zip(reqs, tks))
+    assert bad == 0
+    snap = metrics.snapshot()
+    assert snap["counters"].get("exec.completed", 0) == 13
+    hist = snap["histograms"].get("exec.batch.size")
+    assert hist is not None and hist["max"] >= 2
+    assert "exec.batch.coalesce_wait_ms" in snap["histograms"]
+    # the counter invariant survives coalescing: every compiled request
+    # is accounted as exactly one of hit/miss/size_hit
+    c = snap["counters"]
+    assert (c.get("exec.plan_cache.hit", 0)
+            + c.get("exec.plan_cache.miss", 0)
+            + c.get("exec.plan_cache.size_hit", 0)) == 12
+
+
+def test_mixed_shapes_do_not_coalesce():
+    """Same query over different-shape tables ⇒ different coalesce keys
+    ⇒ no batch ever forms (batching must never mix programs)."""
+    t_a = {"t": _mktab(500, 1)}
+    t_b = {"t": _mktab(700, 2)}              # different shape
+    with xc.QueryScheduler(workers=1, coalesce_ms=100) as sched:
+        blocker, tks = _burst(sched, [("s", _q_sum, t_a, {}),
+                                      ("s", _q_sum, t_b, {}),
+                                      ("s", _q_sum, t_a, {}),
+                                      ("s", _q_sum, t_b, {})])
+        blocker.result(timeout=60)
+        outs = [_canon(tk.result(timeout=60)) for tk in tks]
+    assert _same(outs[0], _canon(_q_sum(t_a))) and _same(outs[0], outs[2])
+    assert _same(outs[1], _canon(_q_sum(t_b))) and _same(outs[1], outs[3])
+    snap = metrics.snapshot()["histograms"]
+    hist = snap.get("exec.batch.size")
+    # same-shape duplicates may batch; across shapes never
+    assert hist is None or hist["max"] <= 2
+
+
+def test_deadline_fires_during_coalesce_window():
+    """A request whose deadline passes while it sits in a coalesce batch
+    gets the typed queue-deadline error; its batch-mates still serve."""
+    tables = {"t": _mktab(400, 3)}
+    oracle = _canon(_q_sum(tables))
+    with xc.QueryScheduler(workers=1, coalesce_ms=200) as sched:
+        blocker, (tk_ok, tk_dl) = _burst(
+            sched, [("s", _q_sum, tables, {}),
+                    ("s", _q_sum, tables, {"timeout_s": 0.01})])
+        blocker.result(timeout=60)
+        assert _same(_canon(tk_ok.result(timeout=60)), oracle)
+        with pytest.raises(xc.ExecDeadlineExceeded) as ei:
+            tk_dl.result(timeout=60)
+        assert ei.value.stage == "queue"
+    assert metrics.snapshot()["counters"].get("exec.deadline.queue", 0) >= 1
+
+
+def test_batch_admission_split_over_cap():
+    """A coalesced batch whose distinct working sets exceed the in-flight
+    cap splits into cap-sized sub-batches instead of blowing the gate."""
+    tabs = [{"t": _mktab(2000, 10 + i)} for i in range(4)]   # same shape
+    one = xc.request_bytes(tabs[0])
+    with xc.QueryScheduler(workers=1, coalesce_ms=100,
+                           inflight_bytes=int(one * 2.5)) as sched:
+        blocker, tks = _burst(
+            sched, [("s", _q_sum, t, {}) for t in tabs])
+        blocker.result(timeout=60)
+        for t, tk in zip(tabs, tks):
+            assert _same(_canon(tk.result(timeout=60)), _canon(_q_sum(t)))
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("exec.batch.split", 0) >= 1
+    assert snap.get("exec.admission.degraded", 0) == 0
+
+
+def test_batched_vmap_distinct_buffers():
+    """Distinct same-shape working sets with WARM verified plans stack
+    onto the vmapped program: one launch, per-request results identical
+    to per-request dispatch."""
+    tabs = [{"t": _mktab(1500, 20 + i)} for i in range(3)]   # same shape
+    plans = xc.PlanCache(cap=8)
+    oracles = []
+    for t in tabs:
+        plans.run("s", _q_sum, t)
+        oracles.append(_canon(plans.run("s", _q_sum, t)))  # 2nd → verified
+    with xc.QueryScheduler(workers=1, coalesce_ms=100,
+                           plan_cache=plans) as sched:
+        blocker, tks = _burst(
+            sched, [("s", _q_sum, t, {}) for t in tabs])
+        blocker.result(timeout=60)
+        for o, tk in zip(oracles, tks):
+            assert _same(_canon(tk.result(timeout=60)), o)
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("compiled.batch_replay", 0) >= 1
+    assert snap.get("compiled.batch_parity_check", 0) >= 1
+    assert snap.get("compiled.batch_parity_reject", 0) == 0
 
 
 # --- prefetch -----------------------------------------------------------------

@@ -16,6 +16,12 @@ stream (round-robin over the chosen queries):
                     cache, admission on.  XLA executions release the
                     GIL, so worker overlap is real compute overlap.
 
+A fourth phase sweeps OFFERED load: paced open-loop arrivals at 1x/2x/4x
+the serial-compiled ceiling with cross-request coalescing on (the
+``batched`` section).  Past 1x a serial server saturates; coalescing
+collapses the same-plan backlog into shared launches, so throughput
+tracks the offered rate while queue wait stays flat.
+
 Every response in every configuration is checked BIT-IDENTICAL to the
 serial eager oracle — concurrency and caching must never change results.
 A final degraded phase re-runs the mix under a deliberately tiny
@@ -108,7 +114,10 @@ def main():
         "wall_s": round(sc_s, 3), "qps": round(n_requests / sc_s, 2)}
     print(f"serial compiled: {n_requests / sc_s:7.2f} qps", flush=True)
 
-    with xc.QueryScheduler(workers=workers, plan_cache=plans) as sched:
+    # coalesce_ms=0: this phase measures pure interleaving (the pre-
+    # batching runtime) so the batched sweep below has a clean baseline
+    with xc.QueryScheduler(workers=workers, plan_cache=plans,
+                           coalesce_ms=0) as sched:
         t0 = time.perf_counter()
         tickets = [sched.submit(q, tpcds.QUERIES[q], tables)
                    for _, q in mix]
@@ -133,6 +142,58 @@ def main():
           f"({serial_s / conc_s:.1f}x serial eager, "
           f"{sc_s / conc_s:.1f}x serial compiled)", flush=True)
 
+    # batched offered-load sweep: paced open-loop arrivals at 1x/2x/4x
+    # the serial-compiled ceiling.  Above 1x a serial server saturates
+    # and queue wait grows without bound; coalescing collapses the
+    # backlog of same-plan requests into shared launches, so measured
+    # throughput tracks the OFFERED rate while queue wait stays flat —
+    # the cross-request batching deliverable, measured.
+    counter_acc = dict(metrics.snapshot()["counters"])
+    sc_qps = n_requests / sc_s
+    results["batched"] = {"coalesce_window_ms": float(
+        os.environ.get("SRJT_EXEC_COALESCE_MS", "4")), "loads": {}}
+    for mult in (1, 2, 4):
+        metrics.reset()
+        rate = sc_qps * mult
+        n_load = n_requests * mult
+        lmix = [(f"req{i}", qnames[i % len(qnames)]) for i in range(n_load)]
+        with xc.QueryScheduler(workers=workers, plan_cache=plans,
+                               queue_depth=max(64, n_load)) as bsched:
+            t0 = time.perf_counter()
+            tickets = []
+            for i, (_, q) in enumerate(lmix):
+                lag = t0 + i / rate - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                tickets.append(bsched.submit(q, tpcds.QUERIES[q], tables))
+            outs = [tk.result(timeout=600) for tk in tickets]
+            bat_s = time.perf_counter() - t0
+        bad = sum(not identical(canon(out), oracle[q])
+                  for out, (_, q) in zip(outs, lmix))
+        assert bad == 0, f"{bad} batched responses diverged at {mult}x"
+        snap = metrics.snapshot()
+        bh = snap["histograms"].get("exec.batch.size")
+        results["batched"]["loads"][f"{mult}x"] = {
+            "offered_qps": round(rate, 2),
+            "requests": n_load,
+            "wall_s": round(bat_s, 3),
+            "qps": round(n_load / bat_s, 2),
+            "qps_vs_serial_compiled": round((n_load / bat_s) / sc_qps, 2),
+            "queue_wait_ms": {
+                "p50": metrics.percentile("exec.queue_wait_ms", 50),
+                "p95": metrics.percentile("exec.queue_wait_ms", 95)},
+            "batch_sizes": None if bh is None else {
+                "launches": bh["count"], "max": bh["max"],
+                "mean": round(bh["total"] / bh["count"], 2)},
+            "responses_identical": True}
+        for k, v in snap["counters"].items():
+            counter_acc[k] = counter_acc.get(k, 0) + v
+        print(f"batched {mult}x load: {n_load / bat_s:7.2f} qps "
+              f"({(n_load / bat_s) / sc_qps:.2f}x serial compiled, "
+              f"batch max {0 if bh is None else bh['max']:.0f})",
+              flush=True)
+    metrics.reset()
+
     # degraded phase: every request over-caps the in-flight ledger →
     # exclusive admission on the sorted engine; must complete, bit-exact
     with xc.QueryScheduler(workers=workers, inflight_bytes=4096) as dsched:
@@ -154,8 +215,9 @@ def main():
     print(f"degraded (4 KiB cap): {n_requests / deg_s:6.2f} qps, "
           f"{degraded}/{n_requests} degraded, all identical", flush=True)
 
-    snap = metrics.snapshot()["counters"]
-    results["counters"] = {k: v for k, v in sorted(snap.items())
+    for k, v in metrics.snapshot()["counters"].items():
+        counter_acc[k] = counter_acc.get(k, 0) + v
+    results["counters"] = {k: v for k, v in sorted(counter_acc.items())
                            if k.startswith(("exec.", "compiled.",
                                             "join.engine."))}
     with open(out_path, "w") as f:
