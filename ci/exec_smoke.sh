@@ -8,7 +8,10 @@
 # admission (sorted join engine) with ≥1 exec.admission.degraded counted
 # and zero wrong results, (4) a same-plan burst coalesces into batched
 # launches (≥1 exec.batch.size sample ≥2) with responses still
-# bit-identical.  Artifacts land in target/exec_smoke/.
+# bit-identical, (5) a forced deadline breach (tiny SRJT_EXEC_DEADLINE)
+# dumps a flight-recorder incident snapshot that parses and carries the
+# breaching request id, and (6) metrics.to_prometheus() passes a
+# text-exposition-format lint.  Artifacts land in target/exec_smoke/.
 #
 # Usage: ci/exec_smoke.sh [n_sales] [queries]
 set -euo pipefail
@@ -134,6 +137,56 @@ assert bh is not None and bh["max"] >= 2, \
     f"burst did not coalesce: {bh}"
 print(f"batched OK: {int(bh['count'])} batched launches, "
       f"max batch {int(bh['max'])}, 0 wrong results")
+
+# 5) forced incident: a deadline breach under the env default deadline
+# must dump a snapshot whose ring covers the breaching request's
+# lifecycle (submit → resolve) — the black-box contract, end to end
+from spark_rapids_jni_tpu.utils import flight
+inc_dir = os.path.join(out, "incidents")
+os.environ["SRJT_INCIDENT_DIR"] = inc_dir
+os.environ["SRJT_EXEC_DEADLINE"] = "0.001"
+flight.reset()
+with xc.QueryScheduler(workers=1, queue_depth=4) as isched:
+    blocker = isched.submit("blocker", slow, tables, compiled=False,
+                            timeout_s=600)
+    doomed = isched.submit("doomed", slow, tables, compiled=False)
+    try:
+        doomed.result(timeout=60)
+        raise AssertionError("env deadline did not fire")
+    except xc.ExecDeadlineExceeded:
+        pass
+    blocker.result(timeout=300)
+del os.environ["SRJT_EXEC_DEADLINE"]
+snaps = [p for p in os.listdir(inc_dir)
+         if p.startswith("incident-deadline-")]
+assert snaps, "deadline breach wrote no incident snapshot"
+with open(os.path.join(inc_dir, snaps[0])) as f:
+    inc = json.load(f)                    # parses — never torn
+assert inc["kind"] == "deadline" and inc["request_id"] == doomed.rid, inc
+rid_kinds = {e["kind"] for e in inc["events"]
+             if e.get("rid") == doomed.rid}
+assert {"exec.submit", "exec.resolve"} <= rid_kinds, rid_kinds
+assert "scheduler.queue_depth" in inc["probes"], inc["probes"]
+print(f"incident OK: {snaps[0]} carries {doomed.rid} lifecycle "
+      f"({sorted(rid_kinds)})")
+
+# 6) Prometheus export lint: every line must match the text exposition
+# grammar (TYPE comments; metric lines name{labels} value)
+import re
+prom = metrics.to_prometheus()
+assert prom.strip(), "empty prometheus export after a served mix"
+line_re = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\"(,[a-zA-Z_][a-zA-Z0-9_]*="
+    r"\"[^\"]*\")*\})? (-?[0-9.e+-]+|\+Inf|-Inf|NaN)$")
+type_re = re.compile(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                     r"(counter|gauge|histogram)$")
+for ln in prom.splitlines():
+    assert (type_re.match(ln) if ln.startswith("#")
+            else line_re.match(ln)), f"prometheus lint: bad line {ln!r}"
+with open(os.path.join(out, "metrics.prom"), "w") as f:
+    f.write(prom)
+print(f"prometheus lint OK: {len(prom.splitlines())} lines")
 
 with open(os.path.join(out, "summary.json"), "w") as f:
     json.dump(metrics.summary(), f, indent=1)

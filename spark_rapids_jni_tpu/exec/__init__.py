@@ -21,6 +21,9 @@ Parts, each its own module:
   (``SRJT_EXEC_PLAN_SIZE_FP``) and vmapped batch execution.
 * :mod:`.prefetch` — double-buffered staging overlapping the next
   request's scan with current execution (``SRJT_EXEC_PREFETCH_DEPTH``).
+* :mod:`.slo` — rolling-window SLO watchdog over resolved requests
+  (``SRJT_SLO_P95_MS`` and friends); breaches alarm through the
+  flight-recorder black box (``utils/flight.py``).
 
 Correctness contract: concurrency, admission degradation, plan caching,
 and prefetch NEVER change results — only latency.  The differential
@@ -38,12 +41,13 @@ from .errors import (ExecDeadlineExceeded, ExecError, ExecQueueFull,
 from .plan_cache import PlanCache
 from .prefetch import Prefetcher
 from .scheduler import QueryScheduler, QueryTicket
+from .slo import SloWatchdog, thresholds_from_env
 
 __all__ = [
     "AdmissionController", "AdmissionGrant", "ExecDeadlineExceeded",
     "ExecError", "ExecQueueFull", "ExecShutdown", "PlanCache",
-    "Prefetcher", "QueryScheduler", "QueryTicket", "enabled",
-    "request_bytes",
+    "Prefetcher", "QueryScheduler", "QueryTicket", "SloWatchdog",
+    "enabled", "request_bytes", "thresholds_from_env",
 ]
 
 

@@ -40,7 +40,7 @@ import time
 from typing import Optional
 
 from ..memory import budget as mbudget
-from ..utils import metrics
+from ..utils import flight, metrics
 from .errors import ExecDeadlineExceeded, ExecShutdown
 
 
@@ -99,15 +99,18 @@ def request_bytes(tables, seen: Optional[set] = None) -> int:
 class AdmissionGrant:
     """One admitted request's hold on the in-flight ledger (context
     manager; exiting releases the bytes and wakes deferred waiters).
-    ``degrade`` tells the worker to run under ``force_engine("sorted")``."""
+    ``degrade`` tells the worker to run under ``force_engine("sorted")``;
+    ``deferred`` reports whether the request waited behind the ladder's
+    stage-2 gate (per-request attribution for the SLO watchdog)."""
 
-    __slots__ = ("nbytes", "degrade", "_ctl", "_released")
+    __slots__ = ("nbytes", "degrade", "deferred", "_ctl", "_released")
 
     def __init__(self, ctl: "AdmissionController", nbytes: int,
-                 degrade: bool):
+                 degrade: bool, deferred: bool = False):
         self._ctl = ctl
         self.nbytes = nbytes
         self.degrade = degrade
+        self.deferred = deferred
         self._released = False
 
     def __enter__(self) -> "AdmissionGrant":
@@ -167,6 +170,9 @@ class AdmissionController:
                     deferred = True
                     if metrics.recording():
                         metrics.count("exec.admission.deferred")
+                    flight.record("exec.admission.defer", rid=name,
+                                  nbytes=n, inflight=self._inflight,
+                                  cap=cap)
                 timeout = None
                 if deadline is not None:
                     timeout = deadline - time.monotonic()
@@ -179,9 +185,12 @@ class AdmissionController:
             self._inflight += hold
             if metrics.recording():
                 metrics.gauge("exec.inflight_bytes", self._inflight)
-        if degrade and metrics.recording():
-            metrics.count("exec.admission.degraded")
-        return AdmissionGrant(self, hold, degrade)
+        if degrade:
+            if metrics.recording():
+                metrics.count("exec.admission.degraded")
+            flight.record("exec.admission.degrade", rid=name, nbytes=n,
+                          cap=cap)
+        return AdmissionGrant(self, hold, degrade, deferred)
 
     def _release(self, nbytes: int) -> None:
         with self._cv:

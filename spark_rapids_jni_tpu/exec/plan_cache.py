@@ -92,6 +92,20 @@ class PlanCache:
             self._d.clear()
             self._by_size.clear()
 
+    def stats(self) -> dict:
+        """Live occupancy + lifetime hit/miss counters (flight-recorder
+        probe and ops-report surface)."""
+        with self._mu:
+            occ = {"entries": len(self._d),
+                   "size_index": len(self._by_size),
+                   "cap": self.cap,
+                   "share_by_size": self.share_by_size,
+                   "building": len(self._building)}
+        for c in ("hit", "miss", "size_hit", "revalidate",
+                  "evictions", "stale", "expired"):
+            occ[c] = metrics.counter_value(f"exec.plan_cache.{c}")
+        return occ
+
     def _evict(self, key, counter: Optional[str]) -> None:
         with self._mu:
             entry = self._d.pop(key, None)

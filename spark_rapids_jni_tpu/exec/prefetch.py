@@ -35,7 +35,7 @@ import time
 from collections import OrderedDict, deque
 from typing import Callable, Optional
 
-from ..utils import metrics
+from ..utils import flight, metrics
 
 
 def _register_staged(obj) -> None:
@@ -203,6 +203,10 @@ class Prefetcher:
                 _register_staged(slot["result"])
             except Exception as e:     # delivered to the taker
                 slot["exc"] = e
+                # black-box breadcrumb: the taker re-raises this on its
+                # own thread, where the staging context is already gone
+                flight.record("exec.prefetch.fail", key=str(key),
+                              error=type(e).__name__)
             finally:
                 slot["loader"] = None
                 slot["done"].set()

@@ -43,12 +43,31 @@ Results are bit-identical to serial execution by construction — the
 batched paths are parity-checked, and every fallback is the ordinary
 per-request dispatch.
 
+**Request lifecycle tracing**: every request carries a request id
+(``<name>#<seq>``, on the ticket as ``rid``) threaded through queue →
+admission → coalesce window → batch membership → dispatch →
+``block_until_ready`` → resolve.  Each stage records (a) a flight-recorder
+event (``utils/flight.py`` — always on, so the black box has the full
+lifecycle when an incident snapshot fires) and (b) an exact per-stage
+latency attribution histogram: ``exec.stage.queue_ms`` (submit →
+dequeue/gather), ``exec.stage.coalesce_ms`` (gather → batch launch),
+``exec.stage.admission_ms``, ``exec.stage.dispatch_ms`` (launch → outputs
+dispatched), ``exec.stage.ready_ms`` (dispatch → buffers materialized) —
+summing to ``exec.e2e_ms`` up to scheduling gaps.  A coalesced launch
+records one ``exec.batch.launch`` event linking every member rid, so the
+shared program's cost is attributable to the requests that rode it.
+Deadline breaches, quarantines, and request failures dump incident
+snapshots; resolved outcomes feed the SLO watchdog (``exec/slo.py``).
+
 Knobs: ``SRJT_EXEC_WORKERS`` (default 4), ``SRJT_EXEC_QUEUE_DEPTH``
 (default 32), ``SRJT_EXEC_COALESCE_MS`` (default 4),
-``SRJT_EXEC_COALESCE_MAX`` (default 16), plus the admission/prefetch/
-plan-cache knobs of the composed parts.  Histograms:
-``exec.queue_wait_ms``, ``exec.admission_wait_ms``, ``exec.exec_ms``,
-``exec.e2e_ms``, ``exec.batch.size``, ``exec.batch.coalesce_wait_ms``.
+``SRJT_EXEC_COALESCE_MAX`` (default 16), ``SRJT_EXEC_DEADLINE`` (default
+end-to-end timeout in seconds for requests submitted without one), plus
+the admission/prefetch/plan-cache knobs of the composed parts.
+Histograms: ``exec.queue_wait_ms``, ``exec.admission_wait_ms``,
+``exec.exec_ms``, ``exec.e2e_ms``, ``exec.batch.size``,
+``exec.batch.coalesce_wait_ms``, and the ``exec.stage.*`` attribution
+family above.
 """
 
 from __future__ import annotations
@@ -64,28 +83,34 @@ from typing import Any, Callable, Optional
 from ..faultinj.resilience import DeviceQuarantined, ResilientExecutor
 from ..memory import budget as mbudget
 from ..models import compiled as C
-from ..utils import metrics
+from ..utils import flight, metrics, structured_log
 from .admission import AdmissionController, request_bytes
 from .errors import (ExecDeadlineExceeded, ExecError, ExecQueueFull,
                      ExecShutdown)
 from .plan_cache import PlanCache
 from .prefetch import Prefetcher
+from .slo import SloWatchdog
 
 
 class QueryTicket:
     """One submitted request's future: resolves to the query result or a
     typed error.  ``result()`` blocks; ``timings`` carries the request's
-    queue-wait/admission-wait/exec seconds once resolved."""
+    per-stage attribution (queue/coalesce/admission/dispatch/ready
+    seconds) once resolved; ``rid`` is the request id every flight-
+    recorder event and log line for this request carries."""
 
-    __slots__ = ("name", "_done", "_result", "_exc", "timings", "degraded")
+    __slots__ = ("name", "rid", "_done", "_result", "_exc", "timings",
+                 "degraded", "batch_rids")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, rid: str = ""):
         self.name = name
+        self.rid = rid
         self._done = threading.Event()
         self._result: Any = None
         self._exc: Optional[BaseException] = None
         self.timings: dict[str, float] = {}
         self.degraded = False
+        self.batch_rids: Optional[list[str]] = None   # coalesced peers
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -109,9 +134,11 @@ class QueryTicket:
 
 class _Request:
     __slots__ = ("name", "qfn", "tables", "loader", "priority", "deadline",
-                 "nbytes", "compiled", "ticket", "t_submit", "seq", "ckey")
+                 "nbytes", "compiled", "ticket", "t_submit", "seq", "ckey",
+                 "rid", "t_gather")
 
     def __init__(self, **kw):
+        self.t_gather = None        # set when pulled into a batch
         for k, v in kw.items():
             setattr(self, k, v)
 
@@ -143,20 +170,49 @@ class QueryScheduler:
         self.queue_depth = max(int(queue_depth), 1)
         self.coalesce_ms = max(float(coalesce_ms), 0.0)
         self.max_batch = max(int(max_batch), 1)
+        self.default_timeout_s: Optional[float] = None
+        dl = os.environ.get("SRJT_EXEC_DEADLINE")
+        if dl:
+            self.default_timeout_s = float(dl)
         self.admission = AdmissionController(inflight_bytes)
         self.plans = plan_cache if plan_cache is not None else PlanCache()
         self.resilient = ResilientExecutor(max_retries=max_retries)
         self.prefetcher = Prefetcher() if prefetch else None
+        self.slo = SloWatchdog()
         self._heap: list[tuple[int, int, _Request]] = []
         self._cv = threading.Condition(threading.Lock())
         self._seq = itertools.count()
         self._closed = False
+        # black-box probes: an incident snapshot from ANY subsystem
+        # carries the live serving state (last scheduler wins the names)
+        flight.register_probe("scheduler.queue_depth", self.pending)
+        flight.register_probe("scheduler.inflight_bytes",
+                              self.admission.inflight_bytes)
+        flight.register_probe("scheduler.plan_cache", self.plans.stats)
+        flight.register_probe("scheduler.slo", self.slo.status)
+        metrics.start_http_server()    # no-op without SRJT_METRICS_PORT
         self._threads = [
             threading.Thread(target=self._worker, name=f"srjt-exec-{i}",
                              daemon=True)
             for i in range(self.workers)]
         for t in self._threads:
             t.start()
+
+    def pending(self) -> int:
+        """Queued-but-undequeued request count (ops probe)."""
+        with self._cv:
+            return len(self._heap)
+
+    def ops_state(self) -> dict:
+        """One dict of live serving state for ``tools/ops_report.py``:
+        queue depth, in-flight bytes, plan-cache stats, SLO status."""
+        return {"queue_depth": self.pending(),
+                "workers": self.workers,
+                "inflight_bytes": self.admission.inflight_bytes(),
+                "inflight_cap": self.admission.cap,
+                "quarantined": self.resilient.quarantined,
+                "plan_cache": self.plans.stats(),
+                "slo": self.slo.status()}
 
     # -- submission ----------------------------------------------------------
 
@@ -179,7 +235,11 @@ class QueryScheduler:
             raise ValueError("submit needs tables or a loader")
         if self.resilient.quarantined:
             raise DeviceQuarantined("executor is quarantined")
-        ticket = QueryTicket(name)
+        if timeout_s is None:
+            timeout_s = self.default_timeout_s
+        seq = next(self._seq)
+        rid = f"{name}#{seq}"
+        ticket = QueryTicket(name, rid)
         now = time.monotonic()
         ckey = None
         if compiled and tables is not None and self.coalesce_ms > 0:
@@ -196,18 +256,24 @@ class QueryScheduler:
             priority=int(priority),
             deadline=(now + timeout_s) if timeout_s is not None else None,
             nbytes=nbytes, compiled=compiled, ticket=ticket,
-            t_submit=now, seq=next(self._seq), ckey=ckey)
+            t_submit=now, seq=seq, ckey=ckey, rid=rid)
         with self._cv:
             if self._closed:
                 raise ExecShutdown("scheduler is shut down")
             if len(self._heap) >= self.queue_depth:
                 if metrics.recording():
                     metrics.count("exec.queue.rejected")
+                flight.record("exec.reject", rid=rid,
+                              depth=self.queue_depth)
                 raise ExecQueueFull(self.queue_depth)
             heapq.heappush(self._heap, (req.priority, req.seq, req))
+            qdepth = len(self._heap)
             # notify_all: idle workers AND workers holding a coalesce
             # window open both need the arrival signal
             self._cv.notify_all()
+        flight.record("exec.submit", rid=rid, priority=int(priority),
+                      qdepth=qdepth,
+                      timeout_s=timeout_s if timeout_s is not None else 0)
         if metrics.recording():
             metrics.count("exec.submitted")
         if loader is not None and self.prefetcher is not None:
@@ -234,6 +300,7 @@ class QueryScheduler:
             self._heap.clear()
             self._cv.notify_all()
         for req in pending:
+            flight.record("exec.resolve", rid=req.rid, outcome="shutdown")
             req.ticket._resolve(exc=ExecShutdown(
                 f"scheduler shut down before {req.name!r} started"))
         self.admission.close()
@@ -242,6 +309,9 @@ class QueryScheduler:
         if wait:
             for t in self._threads:
                 t.join(timeout=30)
+        for probe in ("scheduler.queue_depth", "scheduler.inflight_bytes",
+                      "scheduler.plan_cache", "scheduler.slo"):
+            flight.unregister_probe(probe)
 
     def __enter__(self) -> "QueryScheduler":
         return self
@@ -259,9 +329,11 @@ class QueryScheduler:
                 if not self._heap:
                     return              # closed and drained
                 _, _, req = heapq.heappop(self._heap)
+                req.t_gather = time.monotonic()
                 batch = [req]
                 if req.ckey is not None:
                     self._gather_locked(req.ckey, batch)
+            flight.record("exec.dequeue", rid=req.rid)
             if req.ckey is not None:
                 self._coalesce_wait(req.ckey, batch)
             if len(batch) == 1:
@@ -289,6 +361,9 @@ class QueryScheduler:
             self._heap[:] = keep
             heapq.heapify(self._heap)
             take.sort(key=lambda r: (r.priority, r.seq))
+            now = time.monotonic()
+            for r in take:
+                r.t_gather = now
             batch.extend(take)
 
     def _coalesce_wait(self, ckey, batch: list) -> None:
@@ -314,9 +389,53 @@ class QueryScheduler:
                 n0 = len(batch)
                 self._gather_locked(ckey, batch)
             _bound(batch[n0:])
+        if len(batch) > 1:
+            flight.record("exec.coalesce", rid=batch[0].rid,
+                          batch=[r.rid for r in batch],
+                          wait_ms=round((time.monotonic() - t0) * 1e3, 3))
         if metrics.recording():
             metrics.observe("exec.batch.coalesce_wait_ms",
                             (time.monotonic() - t0) * 1e3)
+
+    # -- resolution (tracing + SLO fan-in) -----------------------------------
+
+    def _stage_obs(self, tk: "QueryTicket", stage: str,
+                   seconds: float) -> None:
+        """Record one stage's attribution: ticket timing + histogram."""
+        tk.timings[f"{stage}_s"] = seconds
+        if metrics.recording():
+            metrics.observe(f"exec.stage.{stage}_ms", seconds * 1e3)
+
+    def _resolve_ok(self, req: "_Request", result, *,
+                    degraded: bool = False, deferred: bool = False) -> None:
+        e2e = req.ticket.timings.get(
+            "e2e_s", time.monotonic() - req.t_submit)
+        flight.record("exec.resolve", rid=req.rid, outcome="ok",
+                      e2e_ms=round(e2e * 1e3, 3), degraded=degraded)
+        self.slo.observe(req.name, e2e * 1e3, outcome="ok",
+                         degraded=degraded, deferred=deferred,
+                         request_id=req.rid)
+        req.ticket._resolve(result=result)
+
+    def _resolve_fail(self, req: "_Request", exc: BaseException,
+                      stage: str, *, outcome: str = "error",
+                      incident_kind: Optional[str] = None,
+                      batch: Optional[list] = None) -> None:
+        """Resolve a request with a typed error, recording the outcome in
+        the flight ring and (for incident-class failures) dumping the
+        black-box snapshot that carries this rid's whole lifecycle."""
+        e2e = time.monotonic() - req.t_submit
+        req.ticket.timings.setdefault("e2e_s", e2e)
+        flight.record("exec.resolve", rid=req.rid, outcome=outcome,
+                      stage=stage, error=type(exc).__name__,
+                      e2e_ms=round(e2e * 1e3, 3))
+        if incident_kind is not None:
+            flight.incident(incident_kind, request_id=req.rid,
+                            batch=batch, stage=stage, error=repr(exc),
+                            query=req.name, e2e_ms=round(e2e * 1e3, 3))
+        self.slo.observe(req.name, e2e * 1e3, outcome=outcome,
+                         request_id=req.rid)
+        req.ticket._resolve(exc=exc)
 
     def _split_by_cap(self, reqs: list) -> list:
         """Greedily pack ``reqs`` into sub-batches whose combined unique
@@ -349,10 +468,14 @@ class QueryScheduler:
         one admission charge per cap-fitting sub-batch, one program
         launch through ``PlanCache.run_batched``."""
         now = time.monotonic()
+        rids = [r.rid for r in batch]
         live = []
         for r in batch:
             qw = now - r.t_submit
             r.ticket.timings["queue_wait_s"] = qw
+            t_gather = r.t_gather if r.t_gather is not None else now
+            self._stage_obs(r.ticket, "queue", t_gather - r.t_submit)
+            self._stage_obs(r.ticket, "coalesce", now - t_gather)
             if metrics.recording():
                 metrics.observe("exec.queue_wait_ms", qw * 1e3)
             if r.deadline is not None and now > r.deadline:
@@ -360,8 +483,10 @@ class QueryScheduler:
                     metrics.count("exec.deadline.queue")
                 if self.prefetcher is not None and r.loader is not None:
                     self.prefetcher.discard((r.name, r.seq))
-                r.ticket._resolve(exc=ExecDeadlineExceeded(
-                    r.name, "queue", qw))
+                self._resolve_fail(
+                    r, ExecDeadlineExceeded(r.name, "queue", qw),
+                    "queue", outcome="deadline", incident_kind="deadline",
+                    batch=rids)
             else:
                 live.append(r)
         for sub, est in self._split_by_cap(live):
@@ -372,6 +497,9 @@ class QueryScheduler:
 
     def _execute_batch(self, batch: list, est: int) -> None:
         name = batch[0].name
+        rids = [r.rid for r in batch]
+        for r in batch:
+            r.ticket.batch_rids = rids
         deadlines = [r.deadline for r in batch if r.deadline is not None]
         try:
             t_adm = time.monotonic()
@@ -381,6 +509,7 @@ class QueryScheduler:
             adm_wait = time.monotonic() - t_adm
             for r in batch:
                 r.ticket.timings["admission_wait_s"] = adm_wait
+                self._stage_obs(r.ticket, "admission", adm_wait)
             if metrics.recording():
                 metrics.observe("exec.admission_wait_ms", adm_wait * 1e3)
         except ExecDeadlineExceeded:
@@ -392,20 +521,25 @@ class QueryScheduler:
                 if r.deadline is not None and now > r.deadline:
                     if metrics.recording():
                         metrics.count("exec.admission.deadline")
-                    r.ticket._resolve(exc=ExecDeadlineExceeded(
-                        r.name, "admission", now - r.t_submit))
+                    self._resolve_fail(
+                        r, ExecDeadlineExceeded(
+                            r.name, "admission", now - r.t_submit),
+                        "admission", outcome="deadline",
+                        incident_kind="deadline", batch=rids)
                 else:
                     self._serve(r)
             return
         except ExecError as e:
             for r in batch:
-                r.ticket._resolve(exc=e)
+                self._resolve_fail(r, e, "admission")
             return
         except BaseException as e:
             if metrics.recording():
                 metrics.count("exec.failed")
             for r in batch:
-                r.ticket._resolve(exc=e)
+                self._resolve_fail(r, e, "admission",
+                                   incident_kind="request_failed",
+                                   batch=rids)
             return
         if grant.degrade:
             # a multi-request sub-batch always fits the cap by
@@ -414,50 +548,61 @@ class QueryScheduler:
             for r in batch:
                 self._serve(r)
             return
+        flight.record("exec.batch.launch", rid=batch[0].rid, batch=rids,
+                      size=len(batch), est_bytes=est)
         t0 = time.monotonic()
         retries0 = self.resilient.retry_count
         try:
-            with grant:
+            with grant, structured_log.bound(batch_rids=",".join(rids)):
                 scope = mbudget.query_budget(
                     name, batched=len(batch)) if mbudget.enabled() \
                     else metrics.span(f"query:{name}", batched=len(batch))
-                with scope, metrics.span("batch", size=len(batch)):
+                with scope, metrics.span("batch", size=len(batch),
+                                         members=",".join(rids)):
                     def _run():
                         return self.plans.run_batched(
                             name, batch[0].qfn,
                             [r.tables for r in batch])
                     outs = self.resilient.submit(_run)
+                    t_disp = time.monotonic()
                     try:
                         import jax
                         outs = jax.block_until_ready(outs)
                     except Exception:
                         pass
-            dt = time.monotonic() - t0
+            t_done = time.monotonic()
+            dt = t_done - t0
+            flight.record("exec.batch.ready", rid=batch[0].rid,
+                          batch=rids, exec_ms=round(dt * 1e3, 3))
             if metrics.recording():
                 metrics.observe("exec.batch.size", len(batch))
                 retried = self.resilient.retry_count - retries0
                 if retried:
                     metrics.count("exec.retries", retried)
-            t_done = time.monotonic()
             for r, out in zip(batch, outs):
                 r.ticket.timings["exec_s"] = dt
                 r.ticket.timings["e2e_s"] = t_done - r.t_submit
+                self._stage_obs(r.ticket, "dispatch", t_disp - t0)
+                self._stage_obs(r.ticket, "ready", t_done - t_disp)
                 if metrics.recording():
                     metrics.observe("exec.exec_ms", dt * 1e3)
                     metrics.observe("exec.e2e_ms",
                                     (t_done - r.t_submit) * 1e3)
                     metrics.count("exec.completed")
-                r.ticket._resolve(result=out)
+                self._resolve_ok(r, out, deferred=grant.deferred)
         except DeviceQuarantined as e:
             if metrics.recording():
                 metrics.count("exec.quarantined")
             for r in batch:
-                r.ticket._resolve(exc=e)
+                self._resolve_fail(r, e, "execute",
+                                   incident_kind="quarantine", batch=rids)
         except BaseException as e:
             if metrics.recording():
                 metrics.count("exec.failed")
             for r in batch:
-                r.ticket._resolve(exc=e)
+                self._resolve_fail(r, e, "execute",
+                                   incident_kind="request_failed",
+                                   batch=rids)
 
     def _serve(self, req: _Request) -> None:
         tk = req.ticket
@@ -467,14 +612,21 @@ class QueryScheduler:
             tk.timings["queue_wait_s"] = queue_wait
             if metrics.recording():
                 metrics.observe("exec.queue_wait_ms", queue_wait * 1e3)
+        if "queue_s" not in tk.timings:
+            t_gather = req.t_gather if req.t_gather is not None else t_dq
+            self._stage_obs(tk, "queue", t_gather - req.t_submit)
+            if t_dq > t_gather:     # held through a coalesce window
+                self._stage_obs(tk, "coalesce", t_dq - t_gather)
         if req.deadline is not None and t_dq > req.deadline:
             if metrics.recording():
                 metrics.count("exec.deadline.queue")
             if self.prefetcher is not None and req.loader is not None:
                 # a dead request's staged tables must not occupy a slot
                 self.prefetcher.discard((req.name, req.seq))
-            tk._resolve(exc=ExecDeadlineExceeded(
-                req.name, "queue", queue_wait))
+            self._resolve_fail(
+                req, ExecDeadlineExceeded(req.name, "queue", queue_wait),
+                "queue", outcome="deadline", incident_kind="deadline",
+                batch=tk.batch_rids)
             return
         try:
             tables = req.tables
@@ -485,25 +637,32 @@ class QueryScheduler:
             est = req.nbytes if req.nbytes is not None \
                 else request_bytes(tables)
             t_adm = time.monotonic()
-            grant = self.admission.admit(est, name=req.name,
+            grant = self.admission.admit(est, name=req.rid or req.name,
                                          deadline=req.deadline)
             adm_wait = time.monotonic() - t_adm
             tk.timings["admission_wait_s"] = adm_wait
+            self._stage_obs(tk, "admission", adm_wait)
             if metrics.recording():
                 metrics.observe("exec.admission_wait_ms", adm_wait * 1e3)
+        except ExecDeadlineExceeded as e:
+            self._resolve_fail(req, e, "admission", outcome="deadline",
+                               incident_kind="deadline",
+                               batch=tk.batch_rids)
+            return
         except ExecError as e:
-            tk._resolve(exc=e)
+            self._resolve_fail(req, e, "admission")
             return
         except BaseException as e:
             if metrics.recording():
                 metrics.count("exec.failed")
-            tk._resolve(exc=e)
+            self._resolve_fail(req, e, "admission",
+                               incident_kind="request_failed")
             return
         tk.degraded = grant.degrade
         t0 = time.monotonic()
         retries0 = self.resilient.retry_count
         try:
-            with grant:
+            with grant, structured_log.bound(request_id=req.rid):
                 # degraded admission: the dense engine's O(key-range)
                 # lookup table is exactly the allocation that does not
                 # fit — route this request's joins to sort-probe (bit-
@@ -533,6 +692,7 @@ class QueryScheduler:
                                 variant="sorted" if grant.degrade else "")
                         return req.qfn(tables)
                     result = self.resilient.submit(_run)
+                    t_disp = time.monotonic()
                     # a response is delivered, not dispatched: JAX
                     # dispatch is async, so resolve tickets only when
                     # the result buffers exist (also forces any lazy
@@ -542,8 +702,11 @@ class QueryScheduler:
                         result = jax.block_until_ready(result)
                     except Exception:
                         pass
-            tk.timings["exec_s"] = time.monotonic() - t0
-            tk.timings["e2e_s"] = time.monotonic() - req.t_submit
+            t_done = time.monotonic()
+            tk.timings["exec_s"] = t_done - t0
+            tk.timings["e2e_s"] = t_done - req.t_submit
+            self._stage_obs(tk, "dispatch", t_disp - t0)
+            self._stage_obs(tk, "ready", t_done - t_disp)
             if metrics.recording():
                 metrics.observe("exec.exec_ms",
                                 tk.timings["exec_s"] * 1e3)
@@ -552,12 +715,17 @@ class QueryScheduler:
                 retried = self.resilient.retry_count - retries0
                 if retried:
                     metrics.count("exec.retries", retried)
-            tk._resolve(result=result)
+            self._resolve_ok(req, result, degraded=grant.degrade,
+                             deferred=grant.deferred)
         except DeviceQuarantined as e:
             if metrics.recording():
                 metrics.count("exec.quarantined")
-            tk._resolve(exc=e)
+            self._resolve_fail(req, e, "execute",
+                               incident_kind="quarantine",
+                               batch=tk.batch_rids)
         except BaseException as e:
             if metrics.recording():
                 metrics.count("exec.failed")
-            tk._resolve(exc=e)
+            self._resolve_fail(req, e, "execute",
+                               incident_kind="request_failed",
+                               batch=tk.batch_rids)

@@ -15,6 +15,7 @@ from __future__ import annotations
 import time
 from typing import Any, Callable
 
+from ..utils import flight
 from .injector import InjectedDeviceError, InjectedOomError
 
 
@@ -46,12 +47,14 @@ class ResilientExecutor:
         while True:
             try:
                 return fn()
-            except InjectedDeviceError:
+            except InjectedDeviceError as e:
                 # fatal: device state unknown — quarantine (the plugin's
                 # "shut down the executor so the cluster manager replaces
                 # it" behavior)
                 self.fatal_count += 1
                 self.quarantined = True
+                flight.incident("quarantine", error=repr(e),
+                                fatal_count=self.fatal_count)
                 raise DeviceQuarantined(
                     "fatal device fault — executor quarantined")
             except (InjectedOomError, MemoryError):

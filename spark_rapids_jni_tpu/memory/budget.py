@@ -46,7 +46,7 @@ import os
 import threading
 from typing import Optional
 
-from ..utils import metrics
+from ..utils import flight, metrics
 
 # pair-expansion working set per output pair in ops/join.py: pair_ids,
 # left_idx, within, r_pos, right_idx int64 lanes + the matched mask
@@ -215,6 +215,7 @@ def charge(nbytes: int, tag: str = "buf", *, strict: bool = False) -> bool:
     if not active() or nbytes <= 0:
         return True
     n = int(nbytes)
+    exc = None
     with _LOCK:
         _process.in_use += n
         limit = limit_now()
@@ -227,8 +228,16 @@ def charge(nbytes: int, tag: str = "buf", *, strict: bool = False) -> bool:
             q = current()
             if metrics.recording():
                 metrics.count("arena.budget.denied")
-            raise HbmBudgetExceeded(n, _process.in_use, limit,
+            exc = HbmBudgetExceeded(n, _process.in_use, limit,
                                     q.name if q else None, tag)
+    if exc is not None:
+        # incident fires OUTSIDE the ledger lock: the snapshot samples
+        # live probes (scheduler queue depth etc.) that take their own
+        # locks, and the black box must never order-invert against them
+        flight.incident("hbm_budget", query=exc.query, tag=tag,
+                        requested=n, in_use=exc.in_use, limit=exc.limit)
+        raise exc
+    with _LOCK:
         _process.peak = max(_process.peak, _process.in_use)
         q = current()
         if q is not None:

@@ -28,7 +28,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
-from ..utils import metrics
+from ..utils import flight, metrics
 from . import budget
 
 _reg: "OrderedDict[object, Resident]" = OrderedDict()
@@ -152,14 +152,25 @@ class SpillableArrays:
             return self.nbytes
 
     def get(self) -> dict:
-        """The device-array dict, faulting back if spilled."""
+        """The device-array dict, faulting back if spilled.  A fault-back
+        that cannot re-upload (device OOM mid-restore) is an incident —
+        the resident's data survives on the host, but the query that
+        touched it is about to fail with the arena in a pressure state
+        worth a black-box snapshot."""
         with self._mu:
             if self._dev is None:
                 import jax.numpy as jnp
-                with metrics.span("arena.faultback", tag=self.tag,
-                                  bytes=self.nbytes):
-                    self._dev = {k: (None if a is None else jnp.asarray(a))
-                                 for k, a in self._host.items()}
+                try:
+                    with metrics.span("arena.faultback", tag=self.tag,
+                                      bytes=self.nbytes):
+                        self._dev = {
+                            k: (None if a is None else jnp.asarray(a))
+                            for k, a in self._host.items()}
+                except BaseException as e:
+                    self._dev = None   # stay spilled; host copy is intact
+                    flight.incident("spill_faultback", tag=self.tag,
+                                    nbytes=self.nbytes, error=repr(e))
+                    raise
                 self._host = None
                 if metrics.recording():
                     metrics.count("arena.faultback.events")

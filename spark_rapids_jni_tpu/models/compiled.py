@@ -45,7 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils import metrics, syncs
+from ..utils import flight, metrics, syncs
 
 
 class StaleTapeError(ValueError):
@@ -129,6 +129,9 @@ class CompiledQuery:
                     diffs = [i for i, (a, b) in
                              enumerate(zip(actual, self.tape)) if int(a) != b]
                     metrics.count("compiled.tape_mismatch")
+                    flight.incident("stale_tape", query=self.name,
+                                    tape_len=len(self.tape),
+                                    positions=diffs[:8])
                     raise StaleTapeError(
                         f"compiled plan is stale: resolved sizes differ from "
                         f"the capture run at tape positions {diffs[:8]} "
@@ -222,6 +225,8 @@ class CompiledQuery:
             if len(ref) != len(got) or any(
                     _bits(r) != _bits(g) for r, g in zip(ref, got)):
                 metrics.count("compiled.batch_parity_reject")
+                flight.incident("vmap_parity_reject", query=self.name,
+                                batch_size=len(tables_list))
                 self._batchable = False
                 return None
             self._batchable = True

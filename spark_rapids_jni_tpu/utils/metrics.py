@@ -35,9 +35,11 @@ Discipline
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
+import re
 import threading
 import time
 from typing import Any, Optional
@@ -49,6 +51,11 @@ _lock = threading.Lock()
 _counters: dict[str, float] = {}
 _gauges: dict[str, float] = {}
 _hists: dict[str, dict] = {}        # name -> {count,total,min,max,buckets}
+# bounded (ts, value) sample tails per histogram, feeding the
+# rolling-window percentile path (the SLO watchdog's quantiles); the
+# log2 buckets above stay the process-lifetime story
+_WINDOW_N = max(int(os.environ.get("SRJT_METRICS_WINDOW_N", "1024")), 16)
+_samples: dict[str, "collections.deque[tuple[float, float]]"] = {}
 
 _EPOCH = time.perf_counter()        # trace time base (ts exported rel. us)
 
@@ -87,6 +94,7 @@ def reset() -> None:
         _counters.clear()
         _gauges.clear()
         _hists.clear()
+        _samples.clear()
         _roots.clear()
 
 
@@ -103,6 +111,12 @@ def count(name: str, value: float = 1, *, in_trace: bool = False) -> None:
         return
     with _lock:
         _counters[name] = _counters.get(name, 0) + value
+
+
+def counter_value(name: str, default: float = 0) -> float:
+    """Read counter ``name`` (``default`` when never incremented)."""
+    with _lock:
+        return _counters.get(name, default)
 
 
 def gauge(name: str, value: float) -> None:
@@ -138,14 +152,41 @@ def observe(name: str, value: float) -> None:
         h["max"] = max(h["max"], value)
         b = f"<=2^{max(int(value), 0).bit_length()}"
         h["buckets"][b] = h["buckets"].get(b, 0) + 1
+        s = _samples.get(name)
+        if s is None:
+            s = _samples[name] = collections.deque(maxlen=_WINDOW_N)
+        s.append((time.monotonic(), value))
 
 
-def percentile(name: str, q: float) -> Optional[float]:
-    """Estimate the ``q``-th percentile (0..100) of histogram ``name`` from
-    its log2 buckets: the answer is the upper edge of the bucket holding
-    the quantile, clamped to the observed min/max.  Coarse (≤2× off) but
-    storage-free — serving latency tails (``tools/serve_bench.py``) need
-    the magnitude, not the digit."""
+def percentile(name: str, q: float,
+               window_s: Optional[float] = None) -> Optional[float]:
+    """The ``q``-th percentile (0..100) of histogram ``name``.
+
+    ``window_s=None`` (default) estimates over the PROCESS LIFETIME from
+    the log2 buckets: the answer is the upper edge of the bucket holding
+    the quantile, clamped to the observed min/max — coarse (≤2× off) but
+    storage-free; serving latency tails need the magnitude, not the
+    digit.
+
+    ``window_s`` computes an EXACT quantile (nearest-rank) over the
+    retained sample tail restricted to the last ``window_s`` seconds —
+    the rolling view the SLO watchdog alarms on.  The tail is bounded
+    (``SRJT_METRICS_WINDOW_N``, default 1024 newest observations), so a
+    long window over a hot histogram sees the newest N, never unbounded
+    storage.  Returns None when no observation falls in the window
+    (including the empty-histogram case); a single in-window sample is
+    its own percentile at every q."""
+    q = min(max(q, 0.0), 100.0)
+    if window_s is not None:
+        cutoff = time.monotonic() - max(float(window_s), 0.0)
+        with _lock:
+            s = _samples.get(name)
+            vals = [v for ts, v in s if ts >= cutoff] if s else []
+        if not vals:
+            return None
+        vals.sort()
+        rank = max(int(-(-len(vals) * q // 100)), 1)   # ceil, 1-based
+        return float(vals[min(rank, len(vals)) - 1])
     with _lock:
         h = _hists.get(name)
         if h is None or not h["count"]:
@@ -153,7 +194,7 @@ def percentile(name: str, q: float) -> Optional[float]:
         lo, hi, total = h["min"], h["max"], h["count"]
         edges = sorted((int(k.rsplit("^", 1)[1]), c)
                        for k, c in h["buckets"].items())
-    target = total * min(max(q, 0.0), 100.0) / 100.0
+    target = total * q / 100.0
     cum = 0
     for exp, c in edges:
         cum += c
@@ -410,3 +451,115 @@ def export_chrome_trace(path: Optional[str] = None) -> str:
     with open(path, "w") as f:
         json.dump(chrome_trace(), f)
     return path
+
+
+# --- Prometheus export ------------------------------------------------------
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    """``exec.queue_wait_ms`` → ``srjt_exec_queue_wait_ms`` (the
+    text-format metric-name grammar admits ``[a-zA-Z_:][a-zA-Z0-9_:]*``)."""
+    n = "srjt_" + _PROM_BAD.sub("_", name)
+    if not re.match(r"[a-zA-Z_:]", n[0]):
+        n = "_" + n
+    return n
+
+
+def _prom_num(v: float) -> str:
+    f = float(v)
+    return repr(int(f)) if f == int(f) and abs(f) < 2 ** 53 else repr(f)
+
+
+def to_prometheus() -> str:
+    """The registry in Prometheus text exposition format (version 0.0.4).
+
+    Counters and gauges export directly; every histogram exports as a
+    native Prometheus histogram — cumulative ``_bucket{le="..."}`` series
+    built from the log2 buckets, plus ``_sum`` and ``_count`` — so a
+    scrape of the serving runtime yields rate()-able latency and
+    admission series without any sidecar.  The output is linted against
+    the grammar in CI (``ci/exec_smoke.sh``)."""
+    with _lock:
+        counters = dict(_counters)
+        gauges = dict(_gauges)
+        hists = {k: {**v, "buckets": dict(v["buckets"])}
+                 for k, v in _hists.items()}
+    lines: list[str] = []
+    for name, v in sorted(counters.items()):
+        p = _prom_name(name)
+        lines.append(f"# TYPE {p} counter")
+        lines.append(f"{p} {_prom_num(v)}")
+    for name, v in sorted(gauges.items()):
+        p = _prom_name(name)
+        lines.append(f"# TYPE {p} gauge")
+        lines.append(f"{p} {_prom_num(v)}")
+    for name, h in sorted(hists.items()):
+        p = _prom_name(name)
+        lines.append(f"# TYPE {p} histogram")
+        edges = sorted((int(k.rsplit("^", 1)[1]), c)
+                       for k, c in h["buckets"].items())
+        cum = 0
+        for exp, c in edges:
+            cum += c
+            lines.append(f'{p}_bucket{{le="{float(1 << exp)!r}"}} {cum}')
+        lines.append(f'{p}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{p}_sum {_prom_num(h['total'])}")
+        lines.append(f"{p}_count {h['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+_http_server = None
+_http_lock = threading.Lock()
+
+
+def start_http_server(port: Optional[int] = None):
+    """Serve :func:`to_prometheus` on ``http://0.0.0.0:<port>/metrics``
+    from a daemon thread (the ops scrape surface; ``SRJT_METRICS_PORT``).
+    Idempotent — one server per process; returns it (``.server_port``
+    carries the bound port, useful with ``port=0`` in tests), or None
+    when no port is configured."""
+    global _http_server
+    if port is None:
+        port = os.environ.get("SRJT_METRICS_PORT")
+        if not port:
+            return None
+    port = int(port)
+    with _http_lock:
+        if _http_server is not None:
+            return _http_server
+        import http.server
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):            # noqa: N802 (stdlib API name)
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = to_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):    # scrapes must not spam stderr
+                pass
+
+        _http_server = http.server.ThreadingHTTPServer(
+            ("0.0.0.0", port), _Handler)
+        threading.Thread(target=_http_server.serve_forever,
+                         name="srjt-metrics-http", daemon=True).start()
+        return _http_server
+
+
+def stop_http_server() -> None:
+    """Shut the scrape endpoint down (tests)."""
+    global _http_server
+    with _http_lock:
+        if _http_server is not None:
+            _http_server.shutdown()
+            _http_server.server_close()
+            _http_server = None

@@ -16,6 +16,7 @@ at runtime (the injector-style hot knob).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import sys
@@ -27,6 +28,42 @@ _lock = threading.Lock()
 _mode: str = os.environ.get("SPARK_RAPIDS_TPU_LOG", "off").lower()
 _path: Optional[str] = os.environ.get("SPARK_RAPIDS_TPU_LOG_FILE")
 _stream = None
+_tls = threading.local()               # per-thread bound context fields
+
+
+def bind(**fields) -> None:
+    """Bind fields onto every subsequent :func:`event` from THIS thread
+    (until :func:`unbind`): the serving workers bind ``request_id`` so a
+    request's whole log trail greps by one key."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        ctx = _tls.ctx = {}
+    ctx.update(fields)
+
+
+def unbind(*names) -> None:
+    """Drop bound fields by name; no names drops everything."""
+    ctx = getattr(_tls, "ctx", None)
+    if not ctx:
+        return
+    if not names:
+        ctx.clear()
+    for n in names:
+        ctx.pop(n, None)
+
+
+@contextlib.contextmanager
+def bound(**fields):
+    """Context-managed :func:`bind`: fields apply inside, restore after."""
+    ctx = getattr(_tls, "ctx", None)
+    saved = dict(ctx) if ctx else {}
+    bind(**fields)
+    try:
+        yield
+    finally:
+        if getattr(_tls, "ctx", None) is not None:
+            _tls.ctx.clear()
+            _tls.ctx.update(saved)
 
 
 def _close_stream_locked() -> None:
@@ -77,9 +114,14 @@ def _out():
 
 
 def event(name: str, duration_s: float | None = None, **fields) -> None:
-    """Emit one structured event (no-op when the knob is off)."""
+    """Emit one structured event (no-op when the knob is off).  Fields
+    bound on this thread via :func:`bind` merge in under the call's own
+    fields (explicit wins)."""
     if not enabled():
         return
+    ctx = getattr(_tls, "ctx", None)
+    if ctx:
+        fields = {**ctx, **fields}
     with _lock:
         if not enabled():         # re-check: racing configure(mode='off')
             return

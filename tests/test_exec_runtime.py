@@ -704,3 +704,146 @@ def test_exec_enabled_gate(monkeypatch):
     assert xc.enabled()
     monkeypatch.setenv("SRJT_EXEC", "off")
     assert not xc.enabled()
+
+
+# --- lifecycle tracing + incidents + SLO -------------------------------------
+
+
+def test_request_lifecycle_traced_end_to_end():
+    from spark_rapids_jni_tpu.utils import flight
+    flight.reset()
+    tables = {"t": _mktab(100, 0)}
+    with xc.QueryScheduler(workers=1) as sched:
+        tk = sched.submit("lc", _q_sum, tables)
+        tk.result(timeout=60)
+    assert tk.rid == "lc#0"
+    kinds = [e["kind"] for e in flight.events(request_id=tk.rid)]
+    assert kinds[0] == "exec.submit"
+    assert "exec.dequeue" in kinds
+    assert kinds[-1] == "exec.resolve"
+    resolve = flight.events(request_id=tk.rid)[-1]
+    assert resolve["outcome"] == "ok" and resolve["e2e_ms"] >= 0
+    # per-stage attribution: the ticket carries every stage in seconds,
+    # and the histograms carry the same family in ms
+    for st in ("queue", "admission", "dispatch", "ready"):
+        assert f"{st}_s" in tk.timings
+    hists = metrics.snapshot()["histograms"]
+    for st in ("queue", "admission", "dispatch", "ready"):
+        assert hists[f"exec.stage.{st}_ms"]["count"] >= 1
+
+
+def test_coalesced_batch_links_member_rids(tpcds_tables):
+    from spark_rapids_jni_tpu.models import tpcds
+    from spark_rapids_jni_tpu.utils import flight
+    flight.reset()
+    plans = xc.PlanCache()
+    for _ in range(2):                      # warm + verify the plan
+        jax.block_until_ready(plans.run("q3", tpcds.QUERIES["q3"],
+                                        tpcds_tables))
+    with xc.QueryScheduler(workers=1, plan_cache=plans,
+                           coalesce_ms=200) as sched:
+        blocker = sched.submit("s", _q_slow, {"t": _mktab(100, 0)},
+                               compiled=False)
+        tks = [sched.submit("q3", tpcds.QUERIES["q3"], tpcds_tables)
+               for _ in range(3)]
+        blocker.result(timeout=60)
+        for tk in tks:
+            tk.result(timeout=120)
+    rids = [tk.rid for tk in tks]
+    launches = [e for e in flight.events()
+                if e["kind"] == "exec.batch.launch"]
+    assert launches and set(launches[0]["batch"]) == set(rids)
+    for tk in tks:
+        assert tk.batch_rids is not None and set(tk.batch_rids) == set(rids)
+
+
+def test_deadline_breach_dumps_incident_snapshot(tmp_path, monkeypatch):
+    import json
+    from spark_rapids_jni_tpu.utils import flight
+    monkeypatch.setenv("SRJT_INCIDENT_DIR", str(tmp_path))
+    flight.reset()
+    tables = {"t": _mktab(100, 0)}
+    with xc.QueryScheduler(workers=1, queue_depth=4) as sched:
+        blocker = sched.submit("s", _q_slow, tables, compiled=False)
+        tk = sched.submit("dl", _q_slow, tables, compiled=False,
+                          timeout_s=0.001)
+        with pytest.raises(xc.ExecDeadlineExceeded):
+            tk.result(timeout=60)
+        blocker.result(timeout=60)
+    snaps = sorted(tmp_path.glob("incident-deadline-*.json"))
+    assert snaps, "deadline breach must dump a snapshot"
+    with open(snaps[0]) as f:
+        snap = json.load(f)
+    assert snap["kind"] == "deadline"
+    assert snap["request_id"] == tk.rid
+    mine = [e for e in snap["events"] if e.get("rid") == tk.rid]
+    assert {"exec.submit", "exec.resolve"} <= {e["kind"] for e in mine}
+    # live serving state rode along via the registered probes
+    assert "scheduler.queue_depth" in snap["probes"]
+
+
+def test_default_deadline_env(monkeypatch):
+    monkeypatch.setenv("SRJT_EXEC_DEADLINE", "0.001")
+    tables = {"t": _mktab(100, 0)}
+    with xc.QueryScheduler(workers=1, queue_depth=4) as sched:
+        assert sched.default_timeout_s == 0.001
+        blocker = sched.submit("s", _q_slow, tables, compiled=False,
+                               timeout_s=600)
+        tk = sched.submit("dl", _q_slow, tables, compiled=False)
+        with pytest.raises(xc.ExecDeadlineExceeded):
+            tk.result(timeout=60)          # env deadline applied
+        blocker.result(timeout=60)
+
+
+def test_slo_watchdog_breach_and_cooldown():
+    slo = xc.SloWatchdog(thresholds={"p95_ms": 10.0}, window_s=60,
+                         min_n=4, cooldown_s=3600)
+    for _ in range(3):
+        assert slo.observe("q", 100.0) == []     # below min population
+    fired = slo.observe("q", 100.0, request_id="q#3")
+    assert len(fired) == 1 and fired[0]["objective"] == "p95_ms"
+    assert slo.observe("q", 100.0) == []         # cooldown holds
+    st = slo.class_status("q")
+    assert st["breached"] and st["objectives"]["p95_ms"]["breached"]
+
+
+def test_slo_watchdog_rates_and_disabled():
+    assert not xc.SloWatchdog(thresholds={}).enabled()
+    slo = xc.SloWatchdog(thresholds={"error_rate": 0.25}, min_n=4,
+                         cooldown_s=3600)
+    for outcome in ("ok", "ok", "error", "error"):
+        fired = slo.observe("q", 1.0, outcome=outcome)
+    assert fired and fired[0]["objective"] == "error_rate"
+    assert slo.class_status("q")["error_rate"] == 0.5
+
+
+def test_scheduler_fires_slo_breach_incident(tmp_path, monkeypatch):
+    monkeypatch.setenv("SRJT_INCIDENT_DIR", str(tmp_path))
+    monkeypatch.setenv("SRJT_SLO_P95_MS", "0.000001")
+    monkeypatch.setenv("SRJT_SLO_MIN_N", "2")
+    tables = {"t": _mktab(100, 0)}
+    with xc.QueryScheduler(workers=1) as sched:
+        for _ in range(3):
+            sched.submit("slowq", _q_sum, tables).result(timeout=60)
+    assert metrics.snapshot()["counters"].get("exec.slo.breach", 0) >= 1
+    assert list(tmp_path.glob("incident-slo_breach-*.json"))
+
+
+def test_ops_state_and_ops_report():
+    import importlib.util
+    import os as _os
+    path = _os.path.join(_os.path.dirname(__file__), "..", "tools",
+                         "ops_report.py")
+    spec = importlib.util.spec_from_file_location("ops_report", path)
+    ops_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ops_report)
+    tables = {"t": _mktab(100, 0)}
+    with xc.QueryScheduler(workers=2) as sched:
+        sched.submit("r", _q_sum, tables).result(timeout=60)
+        st = sched.ops_state()
+        assert st["workers"] == 2 and st["queue_depth"] == 0
+        assert "plan_cache" in st and "slo" in st
+        text = ops_report.report(sched)
+    assert "serving state" in text
+    assert "latency attribution" in text
+    assert "queue" in text

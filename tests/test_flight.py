@@ -1,0 +1,157 @@
+"""Flight recorder (utils/flight.py): ring-buffer bounds, concurrent
+writer atomicity, probe sampling, and the incident snapshot contract —
+valid JSON carrying the triggering request id, its coalesced batch
+peers, and the full ring."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from spark_rapids_jni_tpu.utils import flight, metrics
+
+
+@pytest.fixture(autouse=True)
+def _flight_clean():
+    flight.set_enabled(True)
+    flight.reset()
+    yield
+    flight.reset()
+    flight.set_capacity(int(os.environ.get("SRJT_FLIGHT_N", "512")))
+    flight.set_enabled(None)
+
+
+# --- ring semantics ----------------------------------------------------------
+
+
+def test_ring_overflow_discards_oldest():
+    flight.set_capacity(8)
+    for i in range(20):
+        flight.record("ev", i=i)
+    evs = flight.events()
+    assert len(evs) == 8
+    assert [e["i"] for e in evs] == list(range(12, 20))   # newest 8, in order
+
+
+def test_events_filters_by_request_id_including_batch_membership():
+    flight.record("exec.submit", rid="q#1")
+    flight.record("exec.submit", rid="q#2")
+    flight.record("exec.batch.launch", rid="q#1", batch=["q#1", "q#2"])
+    flight.record("exec.resolve", rid="q#2")
+    evs = flight.events(request_id="q#2")
+    # q#2's own events AND the batch launch it rode as a member
+    assert [e["kind"] for e in evs] == [
+        "exec.submit", "exec.batch.launch", "exec.resolve"]
+
+
+def test_disabled_recorder_records_nothing():
+    flight.set_enabled(False)
+    flight.record("ev", i=1)
+    assert flight.events() == []
+
+
+def test_concurrent_writers_no_torn_records():
+    flight.set_capacity(4096)
+    n_threads, n_each = 6, 200
+    barrier = threading.Barrier(n_threads)
+
+    def writer(t):
+        barrier.wait()
+        for i in range(n_each):
+            flight.record("w", thread=t, i=i, payload=f"{t}:{i}")
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    evs = flight.events()
+    assert len(evs) == n_threads * n_each
+    per_thread = {t: [] for t in range(n_threads)}
+    for e in evs:
+        # every record is whole: all fields present and mutually consistent
+        assert set(e) >= {"ts", "tid", "kind", "thread", "i", "payload"}
+        assert e["payload"] == f"{e['thread']}:{e['i']}"
+        per_thread[e["thread"]].append(e["i"])
+    # per-writer order is preserved (appends happen under the ring lock)
+    for t, seq in per_thread.items():
+        assert seq == list(range(n_each))
+
+
+# --- probes ------------------------------------------------------------------
+
+
+def test_probes_sampled_and_errors_contained():
+    flight.register_probe("depth", lambda: 7)
+    flight.register_probe("boom", lambda: 1 / 0)
+    try:
+        out = flight.sample_probes()
+        assert out["depth"] == 7
+        assert "probe error" in out["boom"]
+    finally:
+        flight.unregister_probe("depth")
+        flight.unregister_probe("boom")
+
+
+# --- incidents ---------------------------------------------------------------
+
+
+def test_incident_snapshot_carries_rid_lifecycle_and_batch(tmp_path,
+                                                           monkeypatch):
+    monkeypatch.setenv("SRJT_INCIDENT_DIR", str(tmp_path))
+    flight.register_probe("queue_depth", lambda: 3)
+    try:
+        flight.record("exec.submit", rid="q3#0")
+        flight.record("exec.submit", rid="q3#1")
+        flight.record("exec.coalesce", rid="q3#0", batch=["q3#0", "q3#1"])
+        path = flight.incident("deadline", request_id="q3#1",
+                               batch=["q3#0", "q3#1"], stage="queue")
+        assert path is not None and os.path.exists(path)
+        with open(path) as f:
+            snap = json.load(f)             # valid JSON, not torn
+        assert snap["kind"] == "deadline"
+        assert snap["request_id"] == "q3#1"
+        assert snap["batch"] == ["q3#0", "q3#1"]
+        assert snap["fields"]["stage"] == "queue"
+        assert snap["probes"]["queue_depth"] == 3
+        kinds = [(e["kind"], e.get("rid")) for e in snap["events"]]
+        assert ("exec.submit", "q3#1") in kinds
+        assert ("exec.coalesce", "q3#0") in kinds      # batch peer linked
+        assert ("incident:deadline", "q3#1") in kinds
+        assert "metrics" in snap
+    finally:
+        flight.unregister_probe("queue_depth")
+
+
+def test_incident_per_kind_cap(tmp_path, monkeypatch):
+    monkeypatch.setenv("SRJT_INCIDENT_DIR", str(tmp_path))
+    monkeypatch.setenv("SRJT_INCIDENT_PER_KIND", "2")
+    paths = [flight.incident("storm", request_id=f"r#{i}")
+             for i in range(5)]
+    written = [p for p in paths if p]
+    assert len(written) == 2                 # cap holds
+    assert len(list(tmp_path.iterdir())) == 2
+    # a different kind has its own budget
+    assert flight.incident("other") is not None
+
+
+def test_incident_without_dir_records_but_writes_nothing(monkeypatch):
+    monkeypatch.delenv("SRJT_INCIDENT_DIR", raising=False)
+    metrics.set_enabled(True)
+    metrics.reset()
+    try:
+        assert flight.incident("quiet", request_id="r#0") is None
+        assert flight.events()[-1]["kind"] == "incident:quiet"
+        assert metrics.snapshot()["counters"]["flight.incidents"] == 1
+    finally:
+        metrics.reset()
+        metrics.set_enabled(None)
+
+
+def test_incident_never_raises_on_unwritable_dir(monkeypatch, tmp_path):
+    bad = tmp_path / "not-a-dir"
+    bad.write_text("file, not dir")
+    monkeypatch.setenv("SRJT_INCIDENT_DIR", str(bad))
+    assert flight.incident("doomed", request_id="r#1") is None

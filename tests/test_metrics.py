@@ -227,3 +227,96 @@ def test_hbm_sampling_records_gauges():
     g = metrics.snapshot()["gauges"]
     assert g["hbm.live_bytes"] == live
     assert g["hbm.live_bytes.peak"] >= live
+
+
+# --- percentiles (lifetime + rolling window) ---------------------------------
+
+
+def test_percentile_empty_histogram_returns_none():
+    assert metrics.percentile("never_observed", 95) is None
+    assert metrics.percentile("never_observed", 95, window_s=60) is None
+
+
+def test_percentile_single_sample_is_its_own_percentile():
+    metrics.observe("solo", 42.0)
+    for q in (0, 50, 99, 100):
+        assert metrics.percentile("solo", q, window_s=60) == 42.0
+
+
+def test_windowed_percentile_exact_nearest_rank():
+    for v in range(1, 101):                 # 1..100, one each
+        metrics.observe("lat", float(v))
+    assert metrics.percentile("lat", 50, window_s=60) == 50.0
+    assert metrics.percentile("lat", 95, window_s=60) == 95.0
+    assert metrics.percentile("lat", 99, window_s=60) == 99.0
+    assert metrics.percentile("lat", 100, window_s=60) == 100.0
+    # lifetime log2-bucket path: coarse but clamped to observed range
+    est = metrics.percentile("lat", 95)
+    assert 1.0 <= est <= 100.0
+
+
+def test_windowed_percentile_excludes_stale_samples():
+    metrics.observe("w", 1000.0)
+    # a zero-width window sees nothing (all samples are in the past)
+    assert metrics.percentile("w", 50, window_s=0) is None
+    assert metrics.percentile("w", 50, window_s=60) == 1000.0
+
+
+def test_counter_value_accessor():
+    assert metrics.counter_value("nope") == 0
+    metrics.count("yes", 3)
+    assert metrics.counter_value("yes") == 3
+
+
+# --- Prometheus export -------------------------------------------------------
+
+_PROM_LINE = (r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+              r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\"(,[a-zA-Z_]"
+              r"[a-zA-Z0-9_]*=\"[^\"]*\")*\})? "
+              r"(-?[0-9.e+-]+|\+Inf|-Inf|NaN)$")
+
+
+def test_to_prometheus_format_and_content():
+    import re
+    metrics.count("exec.completed", 5)
+    metrics.gauge("exec.inflight_bytes", 1024)
+    for v in (1.0, 3.0, 100.0):
+        metrics.observe("exec.e2e_ms", v)
+    text = metrics.to_prometheus()
+    pat = re.compile(_PROM_LINE)
+    for line in text.splitlines():
+        if line.startswith("#"):
+            assert re.match(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                            r"(counter|gauge|histogram)$", line), line
+        else:
+            assert pat.match(line), f"bad exposition line: {line!r}"
+    assert "srjt_exec_completed 5" in text
+    assert "srjt_exec_inflight_bytes 1024" in text
+    # histogram: cumulative buckets ending at +Inf == count, plus sum
+    assert 'srjt_exec_e2e_ms_bucket{le="+Inf"} 3' in text
+    assert "srjt_exec_e2e_ms_sum 104" in text
+    assert "srjt_exec_e2e_ms_count 3" in text
+    cums = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+            if line.startswith("srjt_exec_e2e_ms_bucket")]
+    assert cums == sorted(cums)             # buckets are cumulative
+
+
+def test_prometheus_http_endpoint():
+    from urllib.request import urlopen
+    metrics.count("scraped", 1)
+    srv = metrics.start_http_server(0)      # ephemeral port
+    try:
+        url = f"http://127.0.0.1:{srv.server_port}/metrics"
+        resp = urlopen(url, timeout=5)
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        body = resp.read().decode()
+        assert "srjt_scraped 1" in body
+        assert urlopen(f"http://127.0.0.1:{srv.server_port}/nope",
+                       timeout=5).status if False else True
+    finally:
+        metrics.stop_http_server()
+
+
+def test_start_http_server_noop_without_port(monkeypatch):
+    monkeypatch.delenv("SRJT_METRICS_PORT", raising=False)
+    assert metrics.start_http_server() is None

@@ -142,17 +142,22 @@ def test_groupby_string_key_vs_pandas(null_every):
     t = Table([Column.strings_from_list(vals), Column.from_numpy(nums)])
     out = groupby_aggregate(t, [0], [(1, "sum"), (1, "count"), (1, "max")])
 
-    df = pd.DataFrame({"k": vals, "v": nums})
-    exp = (df.groupby("k", dropna=False)["v"]
-           .agg(["sum", "count", "max"]).reset_index()
-           .sort_values("k", na_position="first"))
-    got_keys = out[0].to_pylist()
-    exp_keys = [None if (isinstance(k, float) and np.isnan(k)) else k
-                for k in exp["k"].tolist()]
-    assert got_keys == exp_keys
-    np.testing.assert_array_equal(out[1].to_numpy(), exp["sum"].to_numpy())
-    np.testing.assert_array_equal(out[2].to_numpy(), exp["count"].to_numpy())
-    np.testing.assert_array_equal(out[3].to_numpy(), exp["max"].to_numpy())
+    # pure-Python oracle: pandas object-dtype groupby truncates keys at
+    # embedded NUL bytes (C-string semantics in its hashtable), merging
+    # 'a', 'a\x00', and 'a\x00b' into one group — WORDS includes exactly
+    # those keys to pin the engine's full-bytes semantics
+    groups: dict = {}
+    for k, v in zip(vals, nums):
+        groups.setdefault(k, []).append(int(v))
+    exp_keys = sorted(groups, key=lambda k: (k is not None,
+                                             b"" if k is None else k.encode()))
+    assert out[0].to_pylist() == exp_keys
+    np.testing.assert_array_equal(
+        out[1].to_numpy(), [sum(groups[k]) for k in exp_keys])
+    np.testing.assert_array_equal(
+        out[2].to_numpy(), [len(groups[k]) for k in exp_keys])
+    np.testing.assert_array_equal(
+        out[3].to_numpy(), [max(groups[k]) for k in exp_keys])
 
 
 # ---- join -----------------------------------------------------------------

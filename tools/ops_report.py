@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""One-shot ops report for the serving runtime.
+
+Renders, in one terminal page, the state an on-call operator asks for
+first: live request state (queue depth, in-flight bytes, workers,
+quarantine), SLO watchdog status per query class, the latency-attribution
+breakdown (where a request's end-to-end time went, stage by stage), and
+the flight recorder's recent ring.  Three sources, same report:
+
+* **in-process** — ``report(sched)`` on a live ``QueryScheduler``
+  (importable; what a serving harness calls on SIGUSR1 or a debug
+  endpoint).
+* **Prometheus scrape** — ``--url http://host:PORT/metrics`` against a
+  runtime started with ``SRJT_METRICS_PORT``: renders the counter /
+  gauge / histogram families (no live queue state — the scrape surface
+  is the registry, not the scheduler).
+* **incident snapshot** — ``ops_report.py incident-<kind>-*.json``:
+  renders a flight-recorder dump cold, lifecycle events of the breaching
+  request first.
+
+Usage:
+  python tools/ops_report.py <incident.json>           # post-mortem
+  python tools/ops_report.py --url http://host:9f/metrics   # live scrape
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+STAGES = ("queue", "coalesce", "admission", "dispatch", "ready")
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "unlimited"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.0f} {unit}" if unit == "B" else f"{n:.1f} {unit}"
+        n /= 1024
+    return f"{n:.1f} GiB"
+
+
+def _attribution_lines(hists: dict) -> list[str]:
+    """The stage-attribution table from ``exec.stage.*_ms`` histograms
+    (metrics-snapshot dict shape: count/total/min/max)."""
+    rows = []
+    for st in STAGES:
+        h = hists.get(f"exec.stage.{st}_ms")
+        if h and h.get("count"):
+            rows.append((st, h["count"], h["total"] / h["count"], h["max"]))
+    if not rows:
+        return ["  (no exec.stage.* observations)"]
+    total_mean = sum(r[2] for r in rows)
+    out = [f"  {'stage':<10} {'count':>7} {'mean ms':>10} "
+           f"{'max ms':>10} {'share':>7}"]
+    for st, cnt, mean, mx in rows:
+        share = mean / total_mean * 100 if total_mean else 0.0
+        out.append(f"  {st:<10} {cnt:>7} {mean:>10.3f} {mx:>10.3f} "
+                   f"{share:>6.1f}%")
+    return out
+
+
+def _slo_lines(slo: dict) -> list[str]:
+    th = slo.get("thresholds") or {}
+    if not th:
+        return ["  (no SLO objectives configured — set SRJT_SLO_* )"]
+    out = [f"  objectives: {th}  window: {slo.get('window_s')}s"]
+    for cls, st in sorted((slo.get("classes") or {}).items()):
+        if st is None:
+            out.append(f"  {cls:<12} (below min window population)")
+            continue
+        mark = "BREACHED" if st.get("breached") else "ok"
+        out.append(
+            f"  {cls:<12} n={st['n']:<5} p50={st['p50_ms']:.1f}ms "
+            f"p95={st['p95_ms']:.1f}ms p99={st['p99_ms']:.1f}ms "
+            f"err={st['error_rate']:.3f} degr={st['degrade_rate']:.3f} "
+            f"[{mark}]")
+        for obj, v in (st.get("objectives") or {}).items():
+            if v.get("breached"):
+                out.append(f"      !! {obj}: observed {v['observed']} "
+                           f"> limit {v['limit']}")
+    return out
+
+
+def report(sched) -> str:
+    """The live report for an in-process ``QueryScheduler``."""
+    from spark_rapids_jni_tpu.utils import flight, metrics
+    st = sched.ops_state()
+    snap = metrics.snapshot()
+    lines = ["== serving state =="]
+    lines.append(
+        f"  queue depth {st['queue_depth']}  workers {st['workers']}  "
+        f"in-flight {_fmt_bytes(st['inflight_bytes'])} / "
+        f"{_fmt_bytes(st['inflight_cap'])}  "
+        f"quarantined {st['quarantined']}")
+    pc = st["plan_cache"]
+    lines.append(
+        f"  plan cache: {pc['entries']}/{pc['cap']} entries, "
+        f"hit {pc['hit']:.0f} miss {pc['miss']:.0f} "
+        f"size_hit {pc['size_hit']:.0f} stale {pc['stale']:.0f}")
+    lines.append("== SLO watchdog ==")
+    lines.extend(_slo_lines(st["slo"]))
+    lines.append("== latency attribution ==")
+    lines.extend(_attribution_lines(snap["histograms"]))
+    lines.append("== flight ring (newest last) ==")
+    for ev in flight.events(last=15):
+        extra = {k: v for k, v in ev.items()
+                 if k not in ("ts", "tid", "kind")}
+        lines.append(f"  {ev['kind']:<24} {extra}")
+    return "\n".join(lines)
+
+
+def report_incident(path: str) -> str:
+    """Render an incident snapshot file: the breaching request's own
+    lifecycle first, then the serving state the snapshot froze."""
+    with open(path) as f:
+        snap = json.load(f)
+    rid = snap.get("request_id")
+    batch = snap.get("batch") or []
+    lines = [f"== incident: {snap.get('kind')} ==",
+             f"  request {rid}  batch {batch or '-'}",
+             f"  fields: {snap.get('fields')}"]
+    evs = snap.get("events") or []
+    mine = [e for e in evs
+            if rid and (e.get("rid") == rid or rid in (e.get("batch") or ()))]
+    lines.append(f"== lifecycle of {rid} "
+                 f"({len(mine)} of {len(evs)} ring events) ==")
+    for ev in mine or evs[-15:]:
+        extra = {k: v for k, v in ev.items()
+                 if k not in ("ts", "tid", "kind")}
+        lines.append(f"  {ev['kind']:<24} {extra}")
+    probes = snap.get("probes") or {}
+    if probes:
+        lines.append("== probes at incident time ==")
+        for k, v in sorted(probes.items()):
+            lines.append(f"  {k}: {v}")
+    hists = (snap.get("metrics") or {}).get("histograms") or {}
+    lines.append("== latency attribution ==")
+    lines.extend(_attribution_lines(hists))
+    return "\n".join(lines)
+
+
+def report_scrape(url: str) -> str:
+    """Render a ``/metrics`` scrape: the srjt counter/gauge/histogram
+    families grouped, histogram mean from ``_sum``/``_count``."""
+    from urllib.request import urlopen
+    text = urlopen(url, timeout=5).read().decode()
+    counters, gauges, hists = {}, {}, {}
+    types = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split()
+            types[name] = kind
+            continue
+        if not line or line.startswith("#"):
+            continue
+        name, _, val = line.partition(" ")
+        base = name.split("{")[0]
+        if base.endswith("_sum") and types.get(base[:-4]) == "histogram":
+            hists.setdefault(base[:-4], {})["sum"] = float(val)
+        elif base.endswith("_count") and types.get(base[:-6]) == "histogram":
+            hists.setdefault(base[:-6], {})["count"] = float(val)
+        elif types.get(base) == "gauge":
+            gauges[base] = float(val)
+        elif types.get(base) == "counter":
+            counters[base] = float(val)
+    lines = [f"== scrape {url} ==", "== counters =="]
+    for k, v in sorted(counters.items()):
+        lines.append(f"  {k:<44} {v:.0f}")
+    lines.append("== gauges ==")
+    for k, v in sorted(gauges.items()):
+        lines.append(f"  {k:<44} {v:.0f}")
+    lines.append("== histograms (mean ms where applicable) ==")
+    for k, h in sorted(hists.items()):
+        if h.get("count"):
+            lines.append(f"  {k:<44} n={h['count']:.0f} "
+                         f"mean={h['sum'] / h['count']:.3f}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) == 2 and argv[0] == "--url":
+        print(report_scrape(argv[1]))
+        return 0
+    if len(argv) == 1 and not argv[0].startswith("-"):
+        print(report_incident(argv[0]))
+        return 0
+    print(__doc__)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

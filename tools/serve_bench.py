@@ -31,8 +31,14 @@ bench asserts completion with correct results — the "pressure never
 fails a servable request" contract, measured.
 
 Latency detail comes from the runtime's own histograms
-(``exec.queue_wait_ms`` / ``exec.e2e_ms`` p50/p95 via
-``metrics.percentile``) — the numbers a capacity plan needs.
+(``exec.queue_wait_ms`` / ``exec.e2e_ms`` p50/p95/p99 via
+``metrics.percentile``) plus the per-stage attribution family
+(``exec.stage.{queue,coalesce,admission,dispatch,ready}_ms``) — where a
+request's time actually went, the numbers a capacity plan needs.
+
+A final ``flight_overhead`` phase re-runs the 1x paced load with the
+always-on flight recorder OFF and then ON and records the steady-state
+cost (the <2% budget the recorder's always-on discipline promises).
 
 Usage: python tools/serve_bench.py [n_sales] [out.json] [q1,q2,...] [requests]
 """
@@ -56,6 +62,28 @@ def canon(result):
 def identical(a, b) -> bool:
     return len(a) == len(b) and all(
         x.shape == y.shape and np.array_equal(x, y) for x, y in zip(a, b))
+
+
+def hist_pcts(metrics, name):
+    """p50/p95/p99 of one latency histogram (None when unobserved)."""
+    return {"p50": metrics.percentile(name, 50),
+            "p95": metrics.percentile(name, 95),
+            "p99": metrics.percentile(name, 99)}
+
+
+def stage_attribution(metrics):
+    """Per-stage latency breakdown from ``exec.stage.*_ms``: where a
+    request's end-to-end time went, stage by stage."""
+    hists = metrics.snapshot()["histograms"]
+    out = {}
+    for st in ("queue", "coalesce", "admission", "dispatch", "ready"):
+        h = hists.get(f"exec.stage.{st}_ms")
+        if h and h["count"]:
+            out[st] = {"count": h["count"],
+                       "mean_ms": round(h["total"] / h["count"], 3),
+                       "p95_ms": metrics.percentile(
+                           f"exec.stage.{st}_ms", 95)}
+    return out
 
 
 def main():
@@ -131,12 +159,9 @@ def main():
         "qps": round(n_requests / conc_s, 2),
         "speedup_vs_serial": round(serial_s / conc_s, 2),
         "speedup_vs_serial_compiled": round(sc_s / conc_s, 2),
-        "queue_wait_ms": {
-            "p50": metrics.percentile("exec.queue_wait_ms", 50),
-            "p95": metrics.percentile("exec.queue_wait_ms", 95)},
-        "e2e_ms": {
-            "p50": metrics.percentile("exec.e2e_ms", 50),
-            "p95": metrics.percentile("exec.e2e_ms", 95)},
+        "queue_wait_ms": hist_pcts(metrics, "exec.queue_wait_ms"),
+        "e2e_ms": hist_pcts(metrics, "exec.e2e_ms"),
+        "stage_attribution": stage_attribution(metrics),
         "responses_identical": True}
     print(f"concurrent:      {n_requests / conc_s:7.2f} qps "
           f"({serial_s / conc_s:.1f}x serial eager, "
@@ -179,9 +204,9 @@ def main():
             "wall_s": round(bat_s, 3),
             "qps": round(n_load / bat_s, 2),
             "qps_vs_serial_compiled": round((n_load / bat_s) / sc_qps, 2),
-            "queue_wait_ms": {
-                "p50": metrics.percentile("exec.queue_wait_ms", 50),
-                "p95": metrics.percentile("exec.queue_wait_ms", 95)},
+            "queue_wait_ms": hist_pcts(metrics, "exec.queue_wait_ms"),
+            "e2e_ms": hist_pcts(metrics, "exec.e2e_ms"),
+            "stage_attribution": stage_attribution(metrics),
             "batch_sizes": None if bh is None else {
                 "launches": bh["count"], "max": bh["max"],
                 "mean": round(bh["total"] / bh["count"], 2)},
@@ -192,6 +217,41 @@ def main():
               f"({(n_load / bat_s) / sc_qps:.2f}x serial compiled, "
               f"batch max {0 if bh is None else bh['max']:.0f})",
               flush=True)
+    metrics.reset()
+
+    # flight-recorder overhead: the same 1x paced load with the always-on
+    # ring OFF, then ON.  The recorder's contract is that it is cheap
+    # enough to never turn off; this measures that claim on the serving
+    # hot path (a handful of dict builds + deque appends per request).
+    from spark_rapids_jni_tpu.utils import flight
+
+    def paced_1x():
+        with xc.QueryScheduler(workers=workers, plan_cache=plans,
+                               queue_depth=max(64, n_requests)) as fsched:
+            t0 = time.perf_counter()
+            tickets = []
+            for i, (_, q) in enumerate(mix):
+                lag = t0 + i / sc_qps - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                tickets.append(fsched.submit(q, tpcds.QUERIES[q], tables))
+            for tk in tickets:
+                tk.result(timeout=600)
+            return time.perf_counter() - t0
+
+    paced_1x()                          # warm both paths out of band
+    flight.set_enabled(False)
+    off_s = min(paced_1x() for _ in range(2))
+    flight.set_enabled(True)
+    on_s = min(paced_1x() for _ in range(2))
+    flight.set_enabled(None)            # back to the env knob
+    overhead_pct = (on_s - off_s) / off_s * 100
+    results["flight_overhead"] = {
+        "off_wall_s": round(off_s, 3), "on_wall_s": round(on_s, 3),
+        "overhead_pct": round(overhead_pct, 2), "budget_pct": 2.0}
+    print(f"flight recorder: off {n_requests / off_s:7.2f} qps, "
+          f"on {n_requests / on_s:7.2f} qps "
+          f"({overhead_pct:+.2f}% wall)", flush=True)
     metrics.reset()
 
     # degraded phase: every request over-caps the in-flight ledger →
