@@ -23,16 +23,19 @@ CATEGORIES = ["Books", "Home", "Electronics", "Jewelry", "Music",
 STATES = ["TN", "CA", "TX", "WA", "NY", "GA", "OH", "IL"]
 
 
-def _parquet(table: pa.Table) -> bytes:
+def _parquet(table: pa.Table, row_group_size: int | None = None) -> bytes:
     buf = io.BytesIO()
-    pq.write_table(table, buf, compression="SNAPPY", use_dictionary=False)
+    kw = {} if row_group_size is None else {"row_group_size": row_group_size}
+    pq.write_table(table, buf, compression="SNAPPY", use_dictionary=False,
+                   **kw)
     return buf.getvalue()
 
 
 @functools.lru_cache(maxsize=8)
 def generate(n_sales: int = 100_000, n_items: int = 2000,
              n_dates: int = 366 * 3, n_stores: int = 12,
-             seed: int = 42) -> dict[str, bytes]:
+             seed: int = 42,
+             row_group_size: int | None = None) -> dict[str, bytes]:
     # memoized: generation is pure in its arguments, and several test
     # modules ask for identical datasets — returning the SAME byte blobs
     # lets the decode layer's identity memo skip re-scanning them.
@@ -114,6 +117,8 @@ def generate(n_sales: int = 100_000, n_items: int = 2000,
             w_ext, mask=rng.random(n_web) < 0.03),
     })
 
-    return {"store_sales": _parquet(store_sales), "item": _parquet(item),
-            "date_dim": _parquet(date_dim), "store": _parquet(store),
-            "web_sales": _parquet(web_sales)}
+    rgs = row_group_size
+    return {"store_sales": _parquet(store_sales, rgs),
+            "item": _parquet(item, rgs), "date_dim": _parquet(date_dim, rgs),
+            "store": _parquet(store, rgs), "web_sales": _parquet(web_sales,
+                                                                 rgs)}

@@ -51,6 +51,8 @@ if [[ "${1:-}" != "--skip-tests" ]]; then
     python -m pytest tests/ -q
     echo "== exec smoke (serving runtime) =="
     ci/exec_smoke.sh
+    echo "== plan smoke (query planner) =="
+    ci/plan_smoke.sh
 fi
 
 echo "premerge OK"

@@ -94,6 +94,16 @@ class CMD:         # ColumnMetaData (decode-relevant fields)
     DATA_PAGE_OFFSET = 9
     INDEX_PAGE_OFFSET = 10
     DICT_PAGE_OFFSET = 11
+    STATISTICS = 12
+
+
+class ST:          # Statistics (row-group pruning fields)
+    MAX = 1        # deprecated physical-order max (fallback)
+    MIN = 2        # deprecated physical-order min (fallback)
+    NULL_COUNT = 3
+    DISTINCT_COUNT = 4
+    MAX_VALUE = 5  # logical-order max (preferred)
+    MIN_VALUE = 6  # logical-order min (preferred)
 
 
 _PHYS_NP = {PT_INT32: np.dtype("<i4"), PT_INT64: np.dtype("<i8"),
@@ -797,13 +807,32 @@ def _assemble_list(leaf: _Leaf, parts) -> Column:
                   jlist_valid, [child])
 
 
+def _empty_leaf_column(leaf: _Leaf) -> Column:
+    """Zero-row Column for ``leaf`` (all row groups pruned)."""
+    if leaf.phys in _VARLEN_PHYS:
+        values = np.zeros(0, dtype=np.uint8)
+        lens = np.zeros(0, dtype=np.int64)
+    else:
+        values = np.zeros(0, dtype=_PHYS_NP.get(leaf.phys, np.uint8))
+        lens = None
+    child = _present_leaf_column(leaf, values, lens, None)
+    if leaf.max_rep > 0:
+        return Column(T.list_(child.dtype), jnp.zeros((0,), jnp.uint8),
+                      jnp.zeros((1,), jnp.int32), None, [child])
+    return child
+
+
 @fault_site("parquet_read_table")
 def read_table(file_bytes: bytes,
-               columns: Optional[list[str]] = None) -> Table:
+               columns: Optional[list[str]] = None,
+               row_groups: Optional[list[int]] = None) -> Table:
     """Read a parquet file into a device Table.
 
     ``columns`` selects by user-facing column name (for LIST columns, the
     outer field name — the underlying chunk path is ``name.list.element``).
+    ``row_groups`` selects row groups by index (None = all; order within
+    the file is preserved regardless of the order given) — the planner's
+    statistics-driven pruning path.
     """
     from .thrift import parse_struct
     meta = parse_struct(extract_footer_bytes(file_bytes))
@@ -815,8 +844,11 @@ def read_table(file_bytes: bytes,
     with metrics.span("parquet.read_table", columns=len(want),
                       file_bytes=len(file_bytes)):
         groups = meta.get(FMD.ROW_GROUPS)
+        keep = (None if row_groups is None else set(row_groups))
         per_col_parts: dict[int, list] = {i: [] for i in want}
-        for rg in groups.values:
+        for gi, rg in enumerate(groups.values):
+            if keep is not None and gi not in keep:
+                continue
             chunks = rg.get(RG.COLUMNS).values
             for i in want:
                 leaf = leaves[i]
@@ -828,7 +860,9 @@ def read_table(file_bytes: bytes,
         for i in want:
             leaf = leaves[i]
             parts = per_col_parts[i]
-            if leaf.max_rep > 0:
+            if not parts:
+                cols.append(_empty_leaf_column(leaf))
+            elif leaf.max_rep > 0:
                 cols.append(_assemble_list(leaf, parts))
             else:
                 cols.append(_assemble_flat(leaf, parts))

@@ -853,15 +853,102 @@ def stage_column_device(file_bytes: bytes, chunks, leaf):
                 lambda out: Column(dt, out, validity=jvalid))
 
 
+def _chunk_minmax(chunk, leaf):
+    """(min, max) from a column chunk's footer Statistics, or None when
+    the stats are absent/undecodable.  Only INT32/INT64 physical types —
+    the surrogate-key/date-dimension shapes row-group pruning targets."""
+    md = chunk.get(D.CC.META_DATA)
+    st = md.get(D.CMD.STATISTICS)
+    if st is None:
+        return None
+    phys = leaf.phys
+    if phys == D.PT_INT32:
+        fmt, size = "<i", 4
+    elif phys == D.PT_INT64:
+        fmt, size = "<q", 8
+    else:
+        return None
+
+    def dec(v):
+        # explicit None check: b"\x00..." is a perfectly valid (falsy-
+        # looking) PLAIN-encoded bound
+        if v is None or not isinstance(v, (bytes, bytearray)) \
+                or len(v) != size:
+            return None
+        return _struct.unpack(fmt, bytes(v))[0]
+
+    mn = dec(st.get(D.ST.MIN_VALUE))
+    if mn is None:
+        mn = dec(st.get(D.ST.MIN))
+    mx = dec(st.get(D.ST.MAX_VALUE))
+    if mx is None:
+        mx = dec(st.get(D.ST.MAX))
+    if mn is None or mx is None:
+        return None
+    return mn, mx
+
+
+def _group_disjoint(mn: int, mx: int, op: str, val: int) -> bool:
+    """True when NO value in [mn, mx] can satisfy ``col <op> val`` — the
+    row group provably contains no matching rows.  Null rows need no
+    consideration: planner predicates fail nulls, and parquet min/max
+    statistics ignore them."""
+    if op == "eq":
+        return val < mn or val > mx
+    if op == "lt":
+        return mn >= val
+    if op == "le":
+        return mn > val
+    if op == "gt":
+        return mx <= val
+    if op == "ge":
+        return mx < val
+    return False
+
+
+def _prune_row_groups(groups_list, leaves, names, conds):
+    """Indices of row groups that may contain matching rows.  ``conds``
+    is a list of ``(column_name, op, int_value)`` conjuncts (planner
+    contract: ALL must hold, so any single disjoint conjunct drops the
+    group).  Groups without usable statistics are always kept."""
+    name_to_idx = {n: i for i, n in enumerate(names)}
+    kept = []
+    for gi, rg in enumerate(groups_list):
+        chunks = rg.get(D.RG.COLUMNS).values
+        drop = False
+        for cname, op, val in conds:
+            ci = name_to_idx.get(cname)
+            if ci is None:
+                continue
+            mm = _chunk_minmax(chunks[ci], leaves[ci])
+            if mm is None:
+                continue
+            if _group_disjoint(mm[0], mm[1], op, val):
+                drop = True
+                break
+        if not drop:
+            kept.append(gi)
+    return kept
+
+
 @traced("parquet_scan_table_device")
 def scan_table(file_bytes: bytes,
-               columns: Optional[list[str]] = None) -> Table:
+               columns: Optional[list[str]] = None,
+               row_groups: Optional[list[int]] = None,
+               rowgroup_predicate=None) -> Table:
     """``decode.read_table`` with the device fast path per column.
 
     All device-path columns decode in ONE fused jitted program per file
     (``_decode_file_jit``; ``SRJT_FUSED_SCAN=0`` reverts to per-column
     dispatches); host-fallback columns batch through ``decode.read_table``
-    as before."""
+    as before.
+
+    ``row_groups`` selects row groups by index (None = all);
+    ``rowgroup_predicate`` is a list of ``(column, op, int_value)``
+    conjuncts (op in eq/lt/le/gt/ge) tested against footer statistics —
+    row groups provably containing no matching rows are skipped BEFORE
+    any page decode (the planner's filter-pushdown target; counters
+    ``plan.scan.rowgroups_pruned`` / ``plan.scan.rowgroups_kept``)."""
     import os
     meta = parse_struct(extract_footer_bytes(file_bytes))
     leaves = D._leaf_schema_elements(meta)
@@ -869,9 +956,26 @@ def scan_table(file_bytes: bytes,
     want = list(range(len(leaves))) if columns is None else [
         names.index(c) for c in columns]
     groups = meta.get(D.FMD.ROW_GROUPS)
+    groups_list = list(groups.values)
+    kept = (list(range(len(groups_list))) if row_groups is None
+            else sorted(set(row_groups)))
+    if rowgroup_predicate:
+        stat_kept = set(_prune_row_groups(groups_list, leaves, names,
+                                          rowgroup_predicate))
+        pruned = [gi for gi in kept if gi not in stat_kept]
+        kept = [gi for gi in kept if gi in stat_kept]
+        if metrics.recording():
+            metrics.count("plan.scan.rowgroups_pruned", len(pruned))
+            metrics.count("plan.scan.rowgroups_kept", len(kept))
+    selecting = len(kept) < len(groups_list)
+    if not kept:
+        # every row group pruned: zero-row table via the host assembler
+        return D.read_table(
+            file_bytes, row_groups=[],
+            columns=None if columns is None else [names[i] for i in want])
     chunk_lists = {i: [] for i in want}
-    for rg in groups.values:
-        chunks = rg.get(D.RG.COLUMNS).values
+    for gi in kept:
+        chunks = groups_list[gi].get(D.RG.COLUMNS).values
         for i in want:
             chunk_lists[i].append(chunks[i])
 
@@ -912,7 +1016,9 @@ def scan_table(file_bytes: bytes,
         metrics.annotate(device_cols=len(want) - len(fallback),
                          fallback_cols=len(fallback))
     if fallback:
-        host = D.read_table(file_bytes, columns=[names[i] for i in fallback])
+        host = D.read_table(file_bytes,
+                            columns=[names[i] for i in fallback],
+                            row_groups=kept if selecting else None)
         for j, i in enumerate(fallback):
             by_index[i] = host[j]
     out = Table([by_index[i] for i in want])

@@ -1,0 +1,75 @@
+"""Cardinality statistics feeding the join-reorder rule.
+
+Two sources, in priority order:
+
+1. **Exact observations**: the executor (``plan/lower.py``) records every
+   plan node's output row count (static shapes make this free) keyed by
+   the node's structural fingerprint.  Recurring queries — the serving
+   workload — reorder from exact cardinalities on the second sighting.
+2. **Metrics priors**: for join-shaped nodes never seen before, fall back
+   to the process-wide ``join.match_rows`` histogram that
+   ``utils/metrics.py`` already collects on every join — a coarse prior,
+   but enough to rank a filtered dimension against an unfiltered one.
+
+When neither source knows a subtree, ``rows_for`` returns ``None`` and
+the reorder rule rejects (a deliberate no-op: never reorder blind).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from ..utils import metrics
+from . import ir
+
+_MAX_ENTRIES = 4096
+
+
+class CardinalityStats:
+    """Bounded fingerprint → observed-row-count store (thread-safe)."""
+
+    def __init__(self, max_entries: int = _MAX_ENTRIES):
+        self._lock = threading.Lock()
+        self._rows: OrderedDict[str, int] = OrderedDict()
+        self._max = max_entries
+
+    def observe(self, fp: str, rows: int) -> None:
+        with self._lock:
+            self._rows[fp] = int(rows)
+            self._rows.move_to_end(fp)
+            while len(self._rows) > self._max:
+                self._rows.popitem(last=False)
+
+    def rows_for(self, node: ir.Plan):
+        """Estimated output rows of ``node``, or None when unknowable."""
+        with self._lock:
+            got = self._rows.get(ir.fingerprint(node))
+        if got is not None:
+            return float(got)
+        if isinstance(node, (ir.Join, ir.FusedJoinAggregate)):
+            return self._join_prior()
+        return None
+
+    @staticmethod
+    def _join_prior():
+        # mean of the join.match_rows histogram — the coarse process-wide
+        # prior for "how big do joins come out around here"
+        snap = metrics.snapshot()
+        hist = snap.get("histograms", {}).get("join.match_rows")
+        if hist and hist.get("count"):
+            return float(hist["total"]) / float(hist["count"])
+        return None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rows.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+
+#: process-wide store the executor feeds; pass to ``rules.optimize`` to
+#: let recurring queries reorder from observed cardinalities.
+GLOBAL = CardinalityStats()

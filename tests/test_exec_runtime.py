@@ -847,3 +847,27 @@ def test_ops_state_and_ops_report():
     assert "serving state" in text
     assert "latency attribution" in text
     assert "queue" in text
+
+
+# --- plan-tree lowered queries through the serving runtime -------------------
+
+
+def test_plan_lowered_queries_serve_bit_identical(tpcds_tables,
+                                                  tpcds_oracle):
+    """A qfn lowered from an optimized plan tree rides the scheduler
+    unchanged — named by its structural plan fingerprint, cached by the
+    plan cache, and bit-identical to the hand-fused oracle."""
+    from spark_rapids_jni_tpu.models import tpcds_plans
+    qfns = {q: tpcds_plans.plan_fn(q)[0] for q in QNAMES}
+    with xc.QueryScheduler(workers=2) as sched:
+        for _ in range(2):               # second round: plan-cache hits
+            for q in QNAMES:
+                tk = sched.submit(qfns[q].plan_fingerprint, qfns[q],
+                                  tpcds_tables)
+                assert _same(_canon(tk.result(timeout=300)),
+                             tpcds_oracle[q])
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("exec.completed", 0) == 6
+    # 3 distinct plan fingerprints: one compile each, then pure hits
+    assert snap.get("exec.plan_cache.miss", 0) == 3
+    assert snap.get("exec.plan_cache.hit", 0) == 3

@@ -233,6 +233,17 @@ def main():
             entry["cold_wall_s"] = round(time.perf_counter() - t0, 2)
             entry["cold_syncs"] = syncs.reset_sync_count()
             entry["tape_len"] = len(cq.tape)
+            if os.environ.get("SRJT_QB_EXPLAIN") == "1":
+                # planner EXPLAIN for queries that have a plan-tree port
+                try:
+                    from spark_rapids_jni_tpu.models import tpcds_plans
+                    from spark_rapids_jni_tpu.plan import rules as prules
+                    if name in tpcds_plans.PLANS:
+                        entry["plan"] = prules.explain(
+                            tpcds_plans.PLANS[name](),
+                            tpcds_plans.TABLE_SCHEMAS)
+                except Exception as e:          # noqa: BLE001
+                    entry["plan"] = f"explain failed: {e!r}"
             if use_metrics:
                 snap = metrics.snapshot()
                 entry["stages"] = metrics.stage_breakdown()
