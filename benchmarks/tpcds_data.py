@@ -122,3 +122,48 @@ def generate(n_sales: int = 100_000, n_items: int = 2000,
             "item": _parquet(item, rgs), "date_dim": _parquet(date_dim, rgs),
             "store": _parquet(store, rgs), "web_sales": _parquet(web_sales,
                                                                  rgs)}
+
+
+def _store_sales_batch(rng: np.random.Generator, n_rows: int, n_items: int,
+                       n_dates: int, n_stores: int) -> pa.Table:
+    """One batch of store_sales rows, same schema and distributions as
+    ``generate`` (incl. the never-selling last store)."""
+    price_cents = rng.integers(100, 300_00, n_rows).astype(np.int64)
+    list_cents = price_cents + rng.integers(0, 50_00, n_rows)
+    qty = rng.integers(1, 100, n_rows).astype(np.int32)
+    return pa.table({
+        "ss_sold_date_sk": pa.array(
+            rng.integers(1, n_dates + 1, n_rows).astype(np.int32)),
+        "ss_item_sk": pa.array(
+            rng.integers(1, n_items + 1, n_rows).astype(np.int32)),
+        "ss_store_sk": pa.array(
+            rng.integers(1, max(n_stores, 2), n_rows).astype(np.int32)),
+        "ss_quantity": pa.array(qty),
+        "ss_sales_price_cents": pa.array(price_cents),
+        "ss_list_price_cents": pa.array(list_cents),
+        "ss_ext_sales_price": pa.array(
+            (price_cents * qty).astype(np.float64) / 100.0),
+    })
+
+
+def append_rows(n_rows: int, seed: int, *, n_items: int = 2000,
+                n_dates: int = 366 * 3, n_stores: int = 12,
+                row_group_size: int | None = None,
+                base: bytes | None = None) -> bytes:
+    """Deterministic batch of appended ``store_sales`` rows (the streaming
+    ingest unit): schema/distributions match ``generate``, keyed off its
+    own seed stream so epochs are reproducible and disjoint from the base
+    dataset.
+
+    Without ``base``, returns a standalone parquet blob (one or more row
+    groups at ``row_group_size``) for ``stream.DeltaTable.append_file``.
+    With ``base``, returns the base file rewritten with the new rows
+    appended — when the base's row count is a multiple of
+    ``row_group_size`` the existing row-group layout is preserved as a
+    prefix, the contract ``stream.DeltaTable.extend_file`` validates."""
+    rng = np.random.default_rng(seed)
+    batch = _store_sales_batch(rng, n_rows, n_items, n_dates, n_stores)
+    if base is not None:
+        old = pq.read_table(io.BytesIO(base))
+        batch = pa.concat_tables([old, batch])
+    return _parquet(batch, row_group_size)

@@ -53,6 +53,8 @@ if [[ "${1:-}" != "--skip-tests" ]]; then
     ci/exec_smoke.sh
     echo "== plan smoke (query planner) =="
     ci/plan_smoke.sh
+    echo "== stream smoke (incremental maintenance) =="
+    ci/stream_smoke.sh
 fi
 
 echo "premerge OK"

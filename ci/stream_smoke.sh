@@ -1,0 +1,130 @@
+#!/usr/bin/env bash
+# Streaming-maintenance smoke — the stream/ analog of ci/plan_smoke.sh:
+# register ONE q3-shaped view (int64 cents sum: the merge-EXACT spelling)
+# over a small store_sales fact, append three epochs of rows, and assert
+# the two contracts the subsystem exists for:
+#
+#   1. O(delta) work — each refresh decodes EXACTLY the appended file's
+#      row groups (stream.delta.rowgroups in the exported counters; full
+#      recomputes land on stream.scan.rowgroups, so the two can't blur),
+#   2. exactness — every epoch's refreshed result is bit-identical to a
+#      from-scratch recompute of the same plan, including one epoch
+#      routed through the concurrent scheduler (submit_refresh).
+#
+# Artifacts land in target/stream_smoke/ for workflow upload.
+#
+# Usage: ci/stream_smoke.sh [n_sales] [epochs]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N_SALES="${1:-40000}"
+EPOCHS="${2:-3}"
+OUT=target/stream_smoke
+mkdir -p "$OUT"
+
+echo "== stream smoke: $EPOCHS epochs of $((N_SALES / 64))-row appends =="
+XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+SPARK_RAPIDS_TPU_METRICS=1 \
+SRJT_SMOKE_OUT="$OUT" SRJT_SMOKE_N="$N_SALES" SRJT_SMOKE_E="$EPOCHS" \
+python - <<'PYEOF'
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+out = os.environ["SRJT_SMOKE_OUT"]
+n_sales = int(os.environ["SRJT_SMOKE_N"])
+epochs = int(os.environ["SRJT_SMOKE_E"])
+n_append, rgs = max(n_sales // 64, 1), 2048
+
+import numpy as np
+
+from benchmarks import tpcds_data
+from spark_rapids_jni_tpu import exec as xc
+from spark_rapids_jni_tpu.column import force_column
+from spark_rapids_jni_tpu.models import tpcds, tpcds_plans
+from spark_rapids_jni_tpu.plan import ir, lower
+from spark_rapids_jni_tpu.stream import DeltaTable, ViewRegistry
+from spark_rapids_jni_tpu.stream.delta import _file_meta
+from spark_rapids_jni_tpu.utils import metrics
+
+files = tpcds_data.generate(n_sales=n_sales, n_items=500, seed=7,
+                            row_group_size=rgs)
+tables = tpcds.load_tables(files)
+statics = {k: tables[k] for k in ("item", "date_dim", "store")}
+schemas = {k: tpcds_plans.TABLE_SCHEMAS[k] for k in statics}
+delta = DeltaTable("store_sales", files=[files["store_sales"]])
+reg = ViewRegistry(delta, statics, schemas)
+
+j = ir.Join(ir.Join(ir.Scan("store_sales"), ir.Scan("item"),
+                    ("ss_item_sk",), ("i_item_sk",)),
+            ir.Scan("date_dim"), ("ss_sold_date_sk",), ("d_date_sk",))
+f = ir.Filter(j, ir.And((
+    ir.Cmp("==", ir.Col("i_manufact_id"), ir.Lit(436)),
+    ir.Cmp("==", ir.Col("d_moy"), ir.Lit(11)))))
+keys = ("d_year", "i_brand_id", "i_brand")
+plan = ir.Sort(ir.Aggregate(f, keys, (
+    ("ss_sales_price_cents", "sum", "sum_cents"),
+    ("ss_quantity", "count", "n"))), keys)
+
+metrics.reset()
+v = reg.register_view(plan, name="q3_cents")
+assert v.kind == "incremental", v.reason
+assert v.exact
+print(f"view registered: kind={v.kind} exact={v.exact}")
+
+
+def bitcmp(a, b, tag):
+    assert a.num_rows == b.num_rows, (tag, a.num_rows, b.num_rows)
+    for i in range(len(a.columns)):
+        x, y = force_column(a[i]), force_column(b[i])
+        np.testing.assert_array_equal(np.asarray(x.data),
+                                      np.asarray(y.data),
+                                      err_msg=f"{tag} col {i}")
+        if x.offsets is not None:
+            np.testing.assert_array_equal(np.asarray(x.offsets),
+                                          np.asarray(y.offsets))
+
+
+def oracle():
+    cat = lower.TableCatalog({**statics, "store_sales": delta.scan()},
+                             reg.schemas)
+    return lower.execute(v.tree, cat, record_stats=False)
+
+
+bitcmp(reg.refresh(v), oracle(), "epoch0")
+with xc.QueryScheduler(workers=2) as sched:
+    for e in range(1, epochs + 1):
+        blob = tpcds_data.append_rows(n_append, seed=1000 + e, n_items=500,
+                                      row_group_size=rgs)
+        ngroups = len(_file_meta(blob)[0])
+        delta.append_file(blob)
+        c0 = metrics.counter_value("stream.delta.rowgroups")
+        if e == epochs:     # last epoch runs through the serving runtime
+            got = sched.submit_refresh(reg, v).result(timeout=300)
+        else:
+            got = reg.refresh(v)
+        dgroups = int(metrics.counter_value("stream.delta.rowgroups") - c0)
+        assert dgroups == ngroups, (dgroups, ngroups)
+        bitcmp(got, oracle(), f"epoch{e}")
+        print(f"epoch {e}: decoded {dgroups}/{ngroups} appended row "
+              f"groups, result bit-identical to full recompute")
+
+trace_path = metrics.export_chrome_trace(os.path.join(out, "trace.json"))
+with open(trace_path) as fh:
+    doc = json.load(fh)
+counters = doc["srjtCounters"]
+assert counters.get("stream.refresh.incremental", 0) >= epochs, counters
+assert counters.get("stream.refresh.submitted", 0) == 1, counters
+assert counters.get("stream.view.fallback", 0) == 0, counters
+with open(os.path.join(out, "stats.json"), "w") as fh:
+    json.dump(reg.stats(), fh, indent=1)
+print("incremental refreshes:", counters["stream.refresh.incremental"],
+      "| delta row groups:", counters["stream.delta.rowgroups"],
+      "| trace well-formed:", trace_path)
+reg.close()
+PYEOF
+
+echo "stream smoke OK"

@@ -286,6 +286,30 @@ class QueryScheduler:
         """Synchronous convenience: submit + block on the result."""
         return self.submit(name, qfn, tables, **kw).result()
 
+    def submit_refresh(self, registry, view, *, priority: int = 0,
+                       timeout_s: Optional[float] = None) -> QueryTicket:
+        """Route a materialized-view refresh (``stream.ViewRegistry``)
+        through the serving pipeline: same queue, priorities, deadlines,
+        and quarantine as queries — but admission charges only the
+        NOT-YET-CONSUMED delta bytes (the refresh's actual decode work),
+        not the full table, so refreshes of a trickle of appends don't
+        stall behind table-sized admission holds.  Runs eager
+        (``compiled=False``): the refresh closure consults and mutates
+        registry state, so it is never plan-cached or coalesced."""
+        v = registry.resolve(view)
+        est = registry.delta_bytes(v)
+
+        def _refresh(_tables, _registry=registry, _view=v):
+            return _registry.refresh(_view)
+
+        if metrics.recording():
+            metrics.count("stream.refresh.submitted")
+        flight.record("stream.refresh.submit", view=v.name,
+                      view_kind=v.kind, est_bytes=est)
+        return self.submit(f"refresh:{v.name}", _refresh, tables={},
+                           priority=priority, timeout_s=timeout_s,
+                           nbytes=est, compiled=False)
+
     # -- lifecycle -----------------------------------------------------------
 
     def shutdown(self, wait: bool = True) -> None:

@@ -16,7 +16,7 @@ nulls; count counts valid rows).
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +30,10 @@ from .sort import order_by
 
 _AGGS = ("sum", "count", "min", "max", "mean", "var", "std",
          "first", "last")
+
+#: aggregates with a merge-closed partial-state decomposition (the
+#: streaming/incremental-maintenance subset; see AggStateSpec below)
+MERGEABLE_AGGS = ("sum", "count", "min", "max", "mean", "var", "std")
 
 
 def _segment_ids(sorted_keys: list[jnp.ndarray],
@@ -430,3 +434,365 @@ def distinct(table: Table) -> Table:
     """Distinct rows (Spark dropDuplicates over all columns) — a groupby on
     every column with no aggregations; output order is the key sort order."""
     return groupby_aggregate(table, list(range(table.num_columns)), [])
+
+
+# ---------------------------------------------------------------------------
+# Mergeable partial-aggregate states (incremental view maintenance)
+# ---------------------------------------------------------------------------
+# Every MERGEABLE_AGGS aggregate decomposes into a small set of state
+# columns closed under a segment-merge:
+#
+#   count      -> [count]                   merge: int64 add
+#   sum        -> [sum]                     merge: dtype-native segment sum
+#   min / max  -> [min] / [max]             merge: selection over states
+#   mean       -> [sum, count]   (int)      finalize: sum / count
+#              -> [fsum, count]  (f/dec)    fsum = value-domain f64 sum
+#   var / std  -> [count, fsum, m2]         merge: Chan's parallel M2 update
+#
+# so refresh = merge(old_state, partial(delta)).  Exactness contract
+# (``merge_exact``): count always; sum over integer-kind storage and
+# decimals (associative int/limb adds); min/max over any fixed-width
+# (selection — FLOAT64 keeps resident bits, ties resolve to the earliest
+# state row, which is the earliest input row because states are merged in
+# input order); mean over plain integers (int sum + count, one final
+# division).  Float sums/means and merged M2 variance are numerically
+# stable but NOT bit-identical to a full recompute (fp addition is not
+# associative); callers gate on ``merge_exact`` when they need bit-parity.
+# An UNMERGED state finalizes bit-identical for every aggregate — the
+# state pass mirrors ``groupby_aggregate``'s formulas operation for
+# operation.
+
+class StateCol(NamedTuple):
+    kind: str    # "sum" | "count" | "min" | "max" | "fsum" | "m2"
+    src: int     # value-column index in the input relation
+
+
+class OutSpec(NamedTuple):
+    agg: str
+    mode: str                  # "passthrough" | "mean_int" | "mean_f" | "var" | "std"
+    states: tuple[int, ...]    # positions into AggStateSpec.states
+    exact: bool                # merge is bit-identical to full recompute
+
+
+class AggStateSpec(NamedTuple):
+    nkeys: int
+    states: tuple[StateCol, ...]
+    outs: tuple[OutSpec, ...]
+
+    @property
+    def exact(self) -> bool:
+        return all(o.exact for o in self.outs)
+
+
+def merge_exact(agg: str, dtype) -> bool:
+    """True when merging partial states of ``agg`` over a ``dtype`` column
+    reproduces the full recompute bit for bit (see module comment)."""
+    if agg == "count":
+        return True
+    if dtype.is_variable_width or dtype.is_nested:
+        return False
+    if agg in ("min", "max"):
+        return True
+    if agg == "sum":
+        return (dtype.id == T.TypeId.DECIMAL128
+                or dtype.storage.kind in ("i", "u"))
+    if agg == "mean":
+        return not dtype.is_decimal and dtype.storage.kind in ("i", "u")
+    return False     # var/std: merged M2 is stable, not bit-exact
+
+
+def plan_aggregate_states(aggs: Sequence[tuple[int, str]], dtypes,
+                          nkeys: int) -> AggStateSpec:
+    """Plan the state layout for ``aggs`` over a relation whose column
+    ``i`` has dtype ``dtypes[i]``.  States are deduplicated: mean/var over
+    the same column share their sum/count columns."""
+    states: list[StateCol] = []
+
+    def pos(kind: str, src: int) -> int:
+        sc = StateCol(kind, src)
+        if sc in states:
+            return states.index(sc)
+        states.append(sc)
+        return len(states) - 1
+
+    outs: list[OutSpec] = []
+    for vi, agg in aggs:
+        if agg not in MERGEABLE_AGGS:
+            raise ValueError(
+                f"aggregate {agg!r} has no mergeable state form "
+                f"(supported: {MERGEABLE_AGGS})")
+        dt = dtypes[vi]
+        if agg != "count" and (dt.is_variable_width or dt.is_nested):
+            raise NotImplementedError(
+                f"{agg!r} state on {dt.id.name} columns")
+        exact = merge_exact(agg, dt)
+        if agg in ("sum", "count", "min", "max"):
+            outs.append(OutSpec(agg, "passthrough", (pos(agg, vi),), exact))
+        elif agg == "mean":
+            if dt.is_decimal or dt.storage.kind == "f":
+                outs.append(OutSpec(agg, "mean_f",
+                                    (pos("fsum", vi), pos("count", vi)),
+                                    exact))
+            else:
+                outs.append(OutSpec(agg, "mean_int",
+                                    (pos("sum", vi), pos("count", vi)),
+                                    exact))
+        else:    # var / std
+            outs.append(OutSpec(agg, agg,
+                                (pos("count", vi), pos("fsum", vi),
+                                 pos("m2", vi)), False))
+    return AggStateSpec(nkeys, tuple(states), tuple(outs))
+
+
+def _state_dtype(src_dt, kind: str):
+    if kind == "count":
+        return T.int64
+    if kind == "sum":
+        return _agg_out_dtype(src_dt, "sum")
+    if kind in ("min", "max"):
+        return src_dt
+    return T.float64     # fsum / m2
+
+
+def _value_f64(col: Column) -> jnp.ndarray:
+    """Value-domain f64 payload (decimal scale applied) — the accumulator
+    basis shared by the mean/var paths of ``groupby_aggregate``."""
+    data = col.values()
+    if col.dtype.is_decimal:
+        return data.astype(jnp.float64) * np.float64(10.0) ** col.dtype.scale
+    return data.astype(jnp.float64)
+
+
+def _encode_str_keys(table: Table, key_indices):
+    """Swap variable-width key columns for order-preserving dictionary
+    codes (same move as ``_groupby_aggregate``)."""
+    str_dicts: dict[int, Column] = {}
+    work = list(table.columns)
+    for ki in key_indices:
+        if table[ki].dtype.is_nested:
+            raise NotImplementedError(
+                f"{table[ki].dtype.id.name} columns cannot be state keys")
+        if table[ki].dtype.is_variable_width:
+            from . import strings
+            codes, uniq = strings.dictionary_encode(table[ki])
+            work[ki] = codes
+            str_dicts[ki] = uniq
+    return Table(work), str_dicts
+
+
+def _sorted_segments(table: Table, key_indices):
+    """Key-sort + segment ids + group count (one scalar sync); ``table``
+    must already be string-encoded."""
+    order = order_by(table, list(key_indices))
+    st = gather(table, order)
+    skeys, svalid = [], []
+    for ki in key_indices:
+        col = st[ki]
+        if col.dtype.id == T.TypeId.FLOAT64:
+            from ..utils.f64bits import group_key_lanes
+            lo, hi = group_key_lanes(col.data)
+            skeys += [lo, hi]
+            svalid += [col.validity, col.validity]
+        elif col.dtype.id == T.TypeId.DECIMAL128:
+            skeys += [col.data[:, 0], col.data[:, 1]]
+            svalid += [col.validity, col.validity]
+        else:
+            skeys.append(col.data)
+            svalid.append(col.validity)
+    seg_ids = _segment_ids(skeys, svalid)
+    from ..utils import syncs
+    num_segments = syncs.scalar(seg_ids[-1]) + 1
+    return st, seg_ids, num_segments
+
+
+def _head_key_cols(st: Table, key_indices, str_dicts, seg_ids,
+                   num_segments: int, n: int) -> list[Column]:
+    head_pos = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int32), seg_ids,
+                                   num_segments)
+    cols = []
+    for ki in key_indices:
+        head = _take_rows(st[ki], head_pos)
+        if ki in str_dicts:
+            from .filter import _gather_column
+            dec = _gather_column(str_dicts[ki], head.data)
+            cols.append(Column(dec.dtype, dec.data, dec.offsets,
+                               head.validity))
+        else:
+            cols.append(head)
+    return cols
+
+
+def _state_column(col: Column, kind: str, seg_ids, num_segments: int,
+                  n: int) -> Column:
+    """One state column over a key-sorted relation — each branch mirrors
+    the corresponding ``_aggregate_sorted`` formula exactly so an
+    unmerged state finalizes bit-identical to ``groupby_aggregate``."""
+    if kind == "count":
+        res = _agg_segment(col.data, col.validity, seg_ids, "count",
+                           num_segments, "i")
+        return Column(T.int64, res.astype(T.int64.storage))
+    if col.dtype.is_variable_width or col.dtype.is_nested:
+        raise NotImplementedError(
+            f"{kind!r} state on {col.dtype.id.name} columns")
+    if kind == "sum":
+        if col.dtype.id == T.TypeId.DECIMAL128:
+            from . import decimal128 as d128
+            return d128.segmented_sum(col, seg_ids, num_segments)
+        data = col.values()
+        res = _agg_segment(data, col.validity, seg_ids, "sum",
+                           num_segments, col.dtype.storage.kind)
+        dt = _agg_out_dtype(col.dtype, "sum")
+        return Column.from_values(dt, _cast_res(res, dt))
+    if kind in ("min", "max"):
+        if col.dtype.id == T.TypeId.DECIMAL128:
+            raise NotImplementedError("decimal128 min/max states")
+        if col.dtype.id == T.TypeId.FLOAT64:
+            p = _f64_select_pos(col, seg_ids, num_segments, kind)
+            bits = col.data[jnp.clip(p, 0, max(n - 1, 0))]
+            if col.validity is not None:
+                cnt = _agg_segment(col.data[:, 0], col.validity, seg_ids,
+                                   "count", num_segments, "i")
+                return Column(col.dtype, bits, validity=cnt > 0)
+            return Column(col.dtype, bits)
+        data = col.values()
+        res = _agg_segment(data, col.validity, seg_ids, kind,
+                           num_segments, col.dtype.storage.kind)
+        if col.validity is not None:
+            cnt = _agg_segment(data, col.validity, seg_ids, "count",
+                               num_segments, col.dtype.storage.kind)
+            return Column.from_values(col.dtype, _cast_res(res, col.dtype),
+                                      validity=cnt > 0)
+        return Column.from_values(col.dtype, _cast_res(res, col.dtype))
+    if kind == "fsum":
+        x = _value_f64(col)
+        x = x if col.validity is None else jnp.where(col.validity, x, 0.0)
+        s = jax.ops.segment_sum(x, seg_ids, num_segments)
+        return Column.from_values(T.float64, s)
+    if kind == "m2":
+        # mirrors _var_segment's two-pass M2 (ddof applied at finalize)
+        cnt = _agg_segment(col.data if col.dtype.id != T.TypeId.FLOAT64
+                           else col.data[:, 0], col.validity, seg_ids,
+                           "count", num_segments, "i")
+        x = _value_f64(col)
+        x = x if col.validity is None else jnp.where(col.validity, x, 0.0)
+        cntf = cnt.astype(jnp.float64)
+        mean = (jax.ops.segment_sum(x, seg_ids, num_segments)
+                / jnp.maximum(cntf, 1.0))
+        dev = x - mean[seg_ids]
+        if col.validity is not None:
+            dev = jnp.where(col.validity, dev, 0.0)
+        m2 = jax.ops.segment_sum(dev * dev, seg_ids, num_segments)
+        return Column.from_values(T.float64, m2)
+    raise ValueError(f"unknown state kind {kind!r}")
+
+
+def _empty_states(table: Table, key_indices, spec: AggStateSpec) -> Table:
+    cols = [_empty_column_of(table[ki].dtype) for ki in key_indices]
+    for sc in spec.states:
+        cols.append(_empty_column_of(_state_dtype(table[sc.src].dtype,
+                                                  sc.kind)))
+    return Table(cols)
+
+
+def partial_aggregate_states(table: Table, key_indices: Sequence[int],
+                             aggs: Sequence[tuple[int, str]],
+                             spec: AggStateSpec | None = None) -> Table:
+    """Partial-aggregate state table for ``aggs`` GROUP BY ``key_indices``:
+    [key columns..., state columns in spec order], one row per distinct
+    key tuple, sorted by key.  Keys must be non-empty (grand-total views
+    fall back to full recompute — the empty-input grand-total row has
+    different null semantics than a merged empty state)."""
+    key_indices = list(key_indices)
+    if not key_indices:
+        raise ValueError("partial aggregate states require group keys")
+    if spec is None:
+        spec = plan_aggregate_states(aggs, [c.dtype for c in table.columns],
+                                     len(key_indices))
+    n = table.num_rows
+    with metrics.span("groupby.partial_states", keys=len(key_indices),
+                      states=len(spec.states), rows=n):
+        if n == 0:
+            return _empty_states(table, key_indices, spec)
+        enc, str_dicts = _encode_str_keys(table, key_indices)
+        st, seg_ids, ns = _sorted_segments(enc, key_indices)
+        cols = _head_key_cols(st, key_indices, str_dicts, seg_ids, ns, n)
+        for sc in spec.states:
+            cols.append(_state_column(st[sc.src], sc.kind, seg_ids, ns, n))
+        return Table(cols)
+
+
+def merge_aggregate_states(spec: AggStateSpec, a: Table | None,
+                           b: Table | None) -> Table:
+    """Merge two state tables (layout per ``partial_aggregate_states``).
+    ``a`` rows come first, so for groups present in both the earlier
+    partition's representative key row and selection ties win — matching
+    a stable full recompute over ``a``-then-``b`` input order."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    from .copying import concat_tables
+    t = concat_tables([a, b])
+    n = t.num_rows
+    if n == 0:
+        return a
+    nk = spec.nkeys
+    key_indices = list(range(nk))
+    with metrics.span("groupby.merge_states", states=len(spec.states),
+                      rows=n):
+        enc, str_dicts = _encode_str_keys(t, key_indices)
+        st, seg_ids, ns = _sorted_segments(enc, key_indices)
+        cols = _head_key_cols(st, key_indices, str_dicts, seg_ids, ns, n)
+        for p, sc in enumerate(spec.states):
+            col = st[nk + p]
+            if sc.kind in ("sum", "count"):
+                # counts merge by summing; the int64 state column keeps
+                # its dtype through the sum branch
+                merged = _state_column(col, "sum", seg_ids, ns, n)
+                if sc.kind == "count":
+                    merged = Column(T.int64, merged.data)
+                cols.append(merged)
+            elif sc.kind in ("min", "max", "fsum"):
+                cols.append(_state_column(col, sc.kind, seg_ids, ns, n))
+            else:    # m2 — Chan's parallel update, generalized to segments:
+                # M2 = sum(m2_i) + sum(n_i * (mean_i - mean_comb)^2)
+                ci = spec.states.index(StateCol("count", sc.src))
+                si = spec.states.index(StateCol("fsum", sc.src))
+                n_i = st[nk + ci].values().astype(jnp.float64)
+                s_i = st[nk + si].values()
+                m_i = col.values()
+                big_n = jax.ops.segment_sum(n_i, seg_ids, ns)
+                big_s = jax.ops.segment_sum(s_i, seg_ids, ns)
+                mean_comb = big_s / jnp.maximum(big_n, 1.0)
+                mean_i = s_i / jnp.maximum(n_i, 1.0)
+                dev = mean_i - mean_comb[seg_ids]
+                m2 = (jax.ops.segment_sum(m_i, seg_ids, ns)
+                      + jax.ops.segment_sum(n_i * dev * dev, seg_ids, ns))
+                cols.append(Column.from_values(T.float64, m2))
+        return Table(cols)
+
+
+def finalize_aggregate_states(spec: AggStateSpec, state: Table) -> Table:
+    """State table → the ``groupby_aggregate`` result it stands for:
+    [key columns..., one column per requested aggregate], formulas
+    mirroring ``_aggregate_sorted`` bit for bit."""
+    nk = spec.nkeys
+    cols = [state[i] for i in range(nk)]
+    for o in spec.outs:
+        if o.mode == "passthrough":
+            cols.append(state[nk + o.states[0]])
+        elif o.mode in ("mean_int", "mean_f"):
+            s = state[nk + o.states[0]].values()
+            cnt = state[nk + o.states[1]].values()
+            res = (s.astype(jnp.float64)
+                   / jnp.maximum(cnt, 1).astype(jnp.float64))
+            cols.append(Column.from_values(T.float64, res))
+        else:    # var / std
+            cnt = state[nk + o.states[0]].values()
+            m2 = state[nk + o.states[2]].values()
+            cntf = cnt.astype(jnp.float64)
+            var = m2 / jnp.maximum(cntf - 1.0, 1.0)
+            res = jnp.sqrt(var) if o.mode == "std" else var
+            cols.append(Column.from_values(T.float64, res,
+                                           validity=cnt >= 2))
+    return Table(cols)

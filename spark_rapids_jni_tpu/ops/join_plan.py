@@ -344,6 +344,64 @@ def _build_index(data, valid, try_dense: bool, must_dense: bool):
                       lut_lo, lut_cnt, bool(unique))
 
 
+def extend_build_index(ix: BuildIndex, delta_data, delta_valid,
+                       base_n: int) -> Optional[BuildIndex]:
+    """Append build rows ``[base_n, base_n + len(delta_data))`` to a dense
+    index, reusing the existing CSR window instead of invalidate-and-
+    rebuild: counts scatter-add into the same ``span`` slots, existing
+    ``row_ids`` remap positionally, delta rows append after each slot's
+    run.  The result is field-identical to ``_build_index`` over the
+    concatenated keys whenever every valid appended key lands inside
+    ``[kmin, kmin + span)`` — within a slot, old rows precede delta rows
+    in original order, exactly the stable key-sorted order a rebuild
+    produces.  Returns None when not applicable (sorted index, or an
+    appended key escapes the window): the caller rebuilds."""
+    if ix.kind != "dense":
+        return None
+    m = int(delta_data.shape[0])
+    if m == 0:
+        return ix
+    d = delta_data.astype(jnp.int64) - ix.kmin
+    ok = jnp.ones(m, jnp.bool_) if delta_valid is None else delta_valid
+    in_win = (d >= 0) & (d < ix.span)
+    if syncs.scalar(jnp.sum((~in_win) & ok)) > 0:
+        if metrics.recording():
+            metrics.count("join.build_index.extend_window_miss")
+        return None
+    m_valid = m if delta_valid is None else syncs.scalar(jnp.sum(ok))
+    if m_valid == 0:
+        return ix
+    with metrics.span("join.build_index.extend", rows=m):
+        slot = jnp.clip(d, 0, ix.span - 1).astype(jnp.int32)
+        new_cnt = ix.lut_cnt.at[slot].add(ok.astype(jnp.int32))
+        new_lo = (jnp.cumsum(new_cnt) - new_cnt).astype(jnp.int32)
+        # remap existing key-sorted positions: position → slot via the old
+        # cumulative counts, then shift by the slot's new start
+        pos = jnp.arange(ix.n_valid, dtype=jnp.int32)
+        cum_old = jnp.cumsum(ix.lut_cnt)
+        old_slot = jnp.searchsorted(cum_old, pos, side="right") \
+            .astype(jnp.int32)
+        old_pos = new_lo[old_slot] + (pos - ix.lut_lo[old_slot])
+        # delta rows stable-sorted by slot (invalid rows sort last via the
+        # span sentinel and are sliced off), ranked within their run
+        sort_key = jnp.where(ok, slot, jnp.int32(ix.span))
+        dorder = jnp.argsort(sort_key, stable=True)[:m_valid]
+        ds = sort_key[dorder]
+        idxs = jnp.arange(m_valid, dtype=jnp.int32)
+        head = jnp.concatenate([jnp.ones(1, jnp.bool_), ds[1:] != ds[:-1]])
+        run_start = jax.lax.cummax(jnp.where(head, idxs, 0))
+        delta_pos = new_lo[ds] + ix.lut_cnt[ds] + (idxs - run_start)
+        n_total = ix.n_valid + m_valid
+        row_ids = jnp.zeros(n_total, jnp.int64) \
+            .at[old_pos].set(ix.row_ids) \
+            .at[delta_pos].set(jnp.int64(base_n) + dorder.astype(jnp.int64))
+        unique = bool(syncs.scalar(jnp.max(new_cnt)) <= 1)
+        if metrics.recording():
+            metrics.count("join.build_index.extended")
+        return BuildIndex("dense", n_total, row_ids, None, ix.kmin, ix.span,
+                          new_lo, new_cnt, unique)
+
+
 def probe_counts(ix: BuildIndex, ldata, lvalid):
     """Per probe row: (first match position into ``ix.row_ids``, match
     count).  ``lo`` is unspecified where ``counts == 0`` (callers guard,

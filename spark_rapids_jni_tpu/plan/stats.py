@@ -17,8 +17,10 @@ the reorder rule rejects (a deliberate no-op: never reorder blind).
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import OrderedDict
+from typing import Optional
 
 from ..utils import metrics
 from . import ir
@@ -26,25 +28,54 @@ from . import ir
 _MAX_ENTRIES = 4096
 
 
-class CardinalityStats:
-    """Bounded fingerprint → observed-row-count store (thread-safe)."""
+def _default_cap() -> int:
+    try:
+        return max(int(os.environ.get("SRJT_PLAN_STATS_CAP",
+                                      _MAX_ENTRIES)), 1)
+    except ValueError:
+        return _MAX_ENTRIES
 
-    def __init__(self, max_entries: int = _MAX_ENTRIES):
+
+class CardinalityStats:
+    """Bounded fingerprint → observed-row-count LRU (thread-safe).
+
+    Long-running serving processes see an unbounded stream of distinct
+    fingerprints; the cap (``SRJT_PLAN_STATS_CAP``, default 4096) bounds
+    the store and *reads refresh recency* — the fingerprints recurring
+    queries actually reorder on survive one-off churn.  Evictions land on
+    the ``plan.stats.evictions`` counter."""
+
+    def __init__(self, max_entries: Optional[int] = None):
         self._lock = threading.Lock()
         self._rows: OrderedDict[str, int] = OrderedDict()
-        self._max = max_entries
+        self._max = _default_cap() if max_entries is None else max(
+            int(max_entries), 1)
+        self._evictions = 0
 
     def observe(self, fp: str, rows: int) -> None:
+        evicted = 0
         with self._lock:
             self._rows[fp] = int(rows)
             self._rows.move_to_end(fp)
             while len(self._rows) > self._max:
                 self._rows.popitem(last=False)
+                evicted += 1
+            self._evictions += evicted
+        if evicted and metrics.recording():
+            metrics.count("plan.stats.evictions", evicted)
+
+    @property
+    def evictions(self) -> int:
+        with self._lock:
+            return self._evictions
 
     def rows_for(self, node: ir.Plan):
         """Estimated output rows of ``node``, or None when unknowable."""
+        fp = ir.fingerprint(node)
         with self._lock:
-            got = self._rows.get(ir.fingerprint(node))
+            got = self._rows.get(fp)
+            if got is not None:
+                self._rows.move_to_end(fp)    # a read IS a use (LRU)
         if got is not None:
             return float(got)
         if isinstance(node, (ir.Join, ir.FusedJoinAggregate)):

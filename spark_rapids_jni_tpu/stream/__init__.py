@@ -1,0 +1,13 @@
+"""Streaming ingest + incremental query maintenance.
+
+Append-only fact tables version through :class:`DeltaTable` (epoch counter
++ per-file row-group watermark); registered aggregate views refresh in
+O(delta) by decoding only appended row groups and merging partial
+aggregate states (:mod:`..ops.groupby`) instead of rescanning — see the
+README "Streaming & incremental maintenance" section.
+"""
+
+from .delta import DeltaTable
+from .view import MaterializedView, ViewRegistry
+
+__all__ = ["DeltaTable", "MaterializedView", "ViewRegistry"]
