@@ -55,6 +55,8 @@ if [[ "${1:-}" != "--skip-tests" ]]; then
     ci/plan_smoke.sh
     echo "== stream smoke (incremental maintenance) =="
     ci/stream_smoke.sh
+    echo "== dict smoke (dictionary-string fast path) =="
+    ci/dict_smoke.sh
 fi
 
 echo "premerge OK"

@@ -221,6 +221,119 @@ class Column:
 
 
 @jax.tree_util.register_pytree_node_class
+class DictColumn(Column):
+    """A STRING column stored as dictionary codes + a small dictionary.
+
+    The classic column-store economy (Abadi et al., SIGMOD'06; cudf
+    DICTIONARY columns): ``codes`` is int32 [n] indexing into ``dictionary``
+    (a plain STRING :class:`Column` of the distinct values, no validity),
+    with row validity carried on the codes.  Null rows hold code 0 — the
+    payload is never read, mirroring how the scan zero-fills null slots.
+
+    Predicates, joins, groupbys and sorts operate on the codes (see
+    ``ops.strings`` / ``ops.join_plan`` / ``ops.groupby`` / ``ops.sort``);
+    the byte payload materializes **lazily** on first ``data``/``offsets``
+    access — the output boundary (rowconv, host extraction) — matching the
+    scan-materialized layout bit-for-bit (null rows are zero-length).
+
+    ``sorted_dict`` marks the dictionary as lexicographically sorted, in
+    which case the codes themselves are order-preserving ranks (sorts and
+    sorted groupbys can use them directly; otherwise
+    ``ops.strings.dict_rank_codes`` re-ranks via the encode memo).
+    """
+
+    def __init__(self, codes: jnp.ndarray, dictionary: Column,
+                 validity: Optional[jnp.ndarray] = None,
+                 sorted_dict: bool = False):
+        self.dtype = T.string
+        self.codes = codes
+        self.dictionary = dictionary
+        self.validity = validity
+        self.sorted_dict = sorted_dict
+        self._mat: Optional[Column] = None
+
+    # -- late materialization ------------------------------------------------
+    def materialize(self) -> Column:
+        """The equivalent plain STRING column (memoized; one size sync)."""
+        if self._mat is None:
+            from .utils import metrics, syncs
+            with metrics.span("strings.dict_materialize",
+                              rows=int(self.codes.shape[0]),
+                              dict_rows=self.dictionary.num_rows):
+                metrics.count("strings.dict.materialize")
+                doffs = self.dictionary.offsets
+                nd = self.dictionary.num_rows
+                safe = jnp.clip(self.codes, 0, max(nd - 1, 0))
+                lens = (doffs[1:] - doffs[:-1])[safe] if nd else jnp.zeros(
+                    self.codes.shape, jnp.int32)
+                if self.validity is not None:
+                    lens = jnp.where(self.validity, lens, 0)  # null ⇒ 0-length
+                offs = jnp.concatenate(
+                    [jnp.zeros(1, jnp.int32), jnp.cumsum(lens)]).astype(jnp.int32)
+                total = syncs.scalar(offs[-1])
+                starts = (doffs[:-1][safe] if nd
+                          else jnp.zeros(self.codes.shape, jnp.int32))
+                elem = jnp.arange(total, dtype=jnp.int64)
+                row_of = jnp.searchsorted(offs.astype(jnp.int64), elem,
+                                          side="right") - 1
+                src = starts.astype(jnp.int64)[row_of] + (
+                    elem - offs.astype(jnp.int64)[row_of])
+                chars = (self.dictionary.data[src] if nd
+                         else jnp.zeros((total,), jnp.uint8))
+                self._mat = Column(T.string, chars, offs, self.validity)
+        return self._mat
+
+    # payload accessors: touching bytes IS the output boundary
+    @property
+    def data(self):
+        return self.materialize().data
+
+    @property
+    def offsets(self):
+        return self.materialize().offsets
+
+    @property
+    def children(self):
+        return None
+
+    @property
+    def num_rows(self) -> int:
+        return self.codes.shape[0]      # static: no materialization for len()
+
+    # -- pytree protocol: dict structure survives jit boundaries -------------
+    def tree_flatten(self):
+        return ((self.codes, self.dictionary, self.validity),
+                ("dict", self.sorted_dict))
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        codes, dictionary, validity = leaves
+        return cls(codes, dictionary, validity, sorted_dict=aux[1])
+
+    # -- host extraction: decode via the dictionary, not the byte payload ----
+    def to_pylist(self):
+        dvals = self.dictionary.to_pylist()
+        codes = np.asarray(self.codes)
+        if self.validity is None:
+            return [dvals[c] for c in codes]
+        valid = np.asarray(self.validity)
+        return [dvals[c] if valid[i] else None
+                for i, c in enumerate(codes)]
+
+
+def as_dict_column(col: Column) -> Optional[DictColumn]:
+    """``col`` as a :class:`DictColumn` if it is one (forcing a cheap lazy
+    wrapper to look), else None — the dispatch point for dict-aware ops."""
+    if isinstance(col, DictColumn):
+        return col
+    if isinstance(col, LazyColumn):
+        inner = col._force()
+        if isinstance(inner, DictColumn):
+            return inner
+    return None
+
+
+@jax.tree_util.register_pytree_node_class
 class LazyColumn(Column):
     """A column whose payload materializes on first access.
 
@@ -278,7 +391,13 @@ class LazyColumn(Column):
         return self._n          # static: no forcing to answer len()
 
     def tree_flatten(self):
-        return self._force().tree_flatten()
+        col = self._force()
+        if isinstance(col, DictColumn):
+            # a LazyColumn flattens with Column's 4-leaf layout; crossing a
+            # jit boundary already materializes, so decode here too rather
+            # than smuggle dict structure under the wrong unflatten
+            col = col.materialize()
+        return col.tree_flatten()
 
     @classmethod
     def tree_unflatten(cls, dtype, leaves):

@@ -216,7 +216,7 @@ class SpillableTable:
             return 0
         freed = 0
         for col in _concrete_columns(t):
-            for field in ("data", "offsets", "validity"):
+            for field in _payload_fields(col):
                 a = getattr(col, field, None)
                 if a is None or not isinstance(a, jax.Array):
                     continue
@@ -231,11 +231,22 @@ class SpillableTable:
         return freed
 
 
+def _payload_fields(col) -> tuple:
+    """The column's spillable payload attributes.  Dict columns spill their
+    CODES (touching ``data``/``offsets`` would materialize the byte payload
+    — allocating under pressure, the opposite of spilling); the shared
+    dictionary spills through its own entry in ``_concrete_columns``."""
+    from ..column import DictColumn
+    if isinstance(col, DictColumn):
+        return ("codes", "validity")
+    return ("data", "offsets", "validity")
+
+
 def _concrete_columns(table):
     """The table's materialized columns, recursing into children; lazy
     columns that were never forced hold no device payload and are left
     untouched (forcing them here would ADD allocations under pressure)."""
-    from ..column import LazyColumn
+    from ..column import DictColumn, LazyColumn
     out = []
     stack = list(table.columns)
     while stack:
@@ -245,6 +256,11 @@ def _concrete_columns(table):
                 continue
             c = c._col
         out.append(c)
+        if isinstance(c, DictColumn):
+            stack.append(c.dictionary)
+            if c._mat is not None:     # already-materialized bytes spill too
+                stack.append(c._mat)
+            continue
         if c.children:
             stack.extend(c.children)
     return out
@@ -255,7 +271,7 @@ def table_device_bytes(table) -> int:
     import jax
     total = 0
     for col in _concrete_columns(table):
-        for field in ("data", "offsets", "validity"):
+        for field in _payload_fields(col):
             a = getattr(col, field, None)
             if a is not None and isinstance(a, jax.Array):
                 total += int(a.nbytes)

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
-from ..column import Column, Table
+from ..column import Column, DictColumn, Table, as_dict_column
 
 
 def _segment_gather(offs: jnp.ndarray, idx: jnp.ndarray):
@@ -37,6 +37,15 @@ def _segment_gather(offs: jnp.ndarray, idx: jnp.ndarray):
 
 
 def _gather_column(col: Column, idx: jnp.ndarray) -> Column:
+    d = as_dict_column(col)
+    if d is not None:
+        # codes gather only — the dictionary is shared, bytes stay unread,
+        # and (unlike the plain STRING branch) there is no size sync
+        from ..utils import metrics
+        metrics.count("strings.dict.gather")
+        dv = None if d.validity is None else d.validity[idx]
+        return DictColumn(d.codes[idx], d.dictionary, dv,
+                          sorted_dict=d.sorted_dict)
     v = None if col.validity is None else col.validity[idx]
     if col.dtype.id == T.TypeId.STRUCT:
         return Column(col.dtype, col.data, None, v,
@@ -63,17 +72,44 @@ def gather(table: Table, idx: jnp.ndarray) -> Table:
     """
     from ..column import LazyColumn
     n_out = int(idx.shape[0])
+    # DictColumns gather EAGERLY: a codes gather is one cheap fixed-width
+    # take with no size sync, and staying a concrete DictColumn (not a lazy
+    # wrapper) keeps the dictionary visible across jit boundaries
     return Table([
+        _gather_column(c, idx) if isinstance(c, DictColumn) else
         LazyColumn(c.dtype, n_out,
                    (lambda c=c: _gather_column(c, idx)))
         for c in table.columns])
+
+
+def sized_nonzero(mask: jnp.ndarray, n_keep: int) -> jnp.ndarray:
+    """Ascending indices of the True rows, shaped ``[n_keep]``.
+
+    Every dynamic-size site is two-phase (count sync, then sized
+    selection), so by the time this runs the mask is usually concrete —
+    and then a host ``np.flatnonzero`` is a single linear pass, where the
+    XLA sized-nonzero lowering routes through a full sort (~100ms on a
+    2M-row mask on CPU, dwarfing the gathers it feeds).  Under a trace
+    (capture/replay) the mask is a tracer and the jittable lowering is
+    the only option; parity is preserved — same ascending order, same
+    zero padding when the clamped size exceeds the population count.
+    """
+    if isinstance(mask, jax.core.Tracer):
+        return jnp.nonzero(mask, size=n_keep)[0]
+    idx = np.flatnonzero(np.asarray(mask))
+    if idx.shape[0] >= n_keep:
+        idx = idx[:n_keep]
+    else:
+        idx = np.pad(idx, (0, n_keep - idx.shape[0]))
+    # same int64 index dtype the sized device lowering produces (x64 on)
+    return jnp.asarray(idx)
 
 
 def apply_boolean_mask(table: Table, mask: jnp.ndarray) -> Table:
     """Keep rows where mask is True (compacting; one host sync for the count)."""
     from ..utils import syncs
     n_keep = syncs.scalar(jnp.sum(mask))   # counted host sync (dynamic size)
-    idx = jnp.nonzero(mask, size=n_keep)[0]
+    idx = sized_nonzero(mask, n_keep)
     return gather(table, idx)
 
 
@@ -87,8 +123,17 @@ def mask_table(table: Table, mask: jnp.ndarray) -> Table:
     from ..column import LazyColumn, force_column
 
     def mk(c):
+        if isinstance(c, DictColumn):   # eager: validity AND only, no bytes
+            v = mask if c.validity is None else (c.validity & mask)
+            return DictColumn(c.codes, c.dictionary, v,
+                              sorted_dict=c.sorted_dict)
+
         def thunk(c=c):
             g = force_column(c)
+            if isinstance(g, DictColumn):
+                v = mask if g.validity is None else (g.validity & mask)
+                return DictColumn(g.codes, g.dictionary, v,
+                                  sorted_dict=g.sorted_dict)
             v = mask if g.validity is None else (g.validity & mask)
             return Column(g.dtype, g.data, g.offsets, v, g.children)
         return LazyColumn(c.dtype, c.num_rows, thunk)
@@ -122,6 +167,21 @@ def isin(col: Column, values) -> jnp.ndarray:
     (IN-lists are short in practice)."""
     if col.dtype.id == T.TypeId.STRING:
         from . import strings
+        d = as_dict_column(col)
+        if d is not None:
+            # membership once per dictionary entry, then gather by code
+            from ..utils import metrics
+            metrics.count("strings.dict.predicate")
+            nd = d.dictionary.num_rows
+            if nd == 0:
+                m = jnp.zeros(d.codes.shape, bool)
+            else:
+                dm = isin(d.dictionary, values)
+                m = dm[jnp.clip(d.codes, 0, nd - 1)]
+            metrics.count("strings.dict.gather")
+            if d.validity is not None:
+                m = m & d.validity
+            return m
         payloads = [v.encode() if isinstance(v, str) else bytes(v)
                     for v in values if v is not None]
         m = jnp.zeros(col.num_rows, bool)

@@ -177,6 +177,9 @@ def _groupby_aggregate(table: Table, key_indices: Sequence[int],
                 "distinct keys")
         if table[ki].dtype.is_variable_width:
             from . import strings
+            from ..column import as_dict_column
+            if as_dict_column(table[ki]) is not None:
+                metrics.count("groupby.dict_keys")
             codes, uniq = strings.dictionary_encode(table[ki])
             work_cols[ki] = codes
             str_dicts[ki] = uniq

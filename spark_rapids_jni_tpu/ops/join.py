@@ -36,7 +36,7 @@ from ..column import Column, Table
 from ..memory import arena
 from ..memory.budget import PAIR_EXPANSION_BYTES
 from ..utils import metrics, syncs
-from .filter import gather
+from .filter import gather, sized_nonzero
 
 JoinKey = Union[Column, Sequence[Column]]
 OnKey = Union[int, Sequence[int]]
@@ -97,7 +97,7 @@ def _join_indices(lcols: list, rcols: list, how: str):
         # the whole plan stays traceable under capture/replay
         m = (counts > 0) if how == "semi" else (counts == 0)
         k = syncs.scalar(jnp.sum(m))
-        return jnp.nonzero(m, size=k)[0]
+        return sized_nonzero(m, k)
 
     if ix.unique and nr > 0:
         # unique build keys: each probe row matches ≤ 1 build row — no pair
@@ -107,7 +107,7 @@ def _join_indices(lcols: list, rcols: list, how: str):
             total = syncs.scalar(jnp.sum(counts))   # scalar sync (pair count)
             if metrics.recording():
                 metrics.observe("join.match_rows", total)
-            left_idx = jnp.nonzero(counts > 0, size=total)[0]
+            left_idx = sized_nonzero(counts > 0, total)
             right_idx = ix.row_ids[pos[left_idx]]
             return left_idx, right_idx
         left_idx = jnp.arange(ldata.shape[0], dtype=jnp.int64)
@@ -164,7 +164,7 @@ def _pair_candidates(ix, lo, counts):
         z = jnp.zeros(0, jnp.int64)
         return z, z
     if ix.unique:
-        left_idx = jnp.nonzero(counts > 0, size=total)[0]
+        left_idx = sized_nonzero(counts > 0, total)
         right_idx = ix.row_ids[jnp.minimum(lo, nr - 1)[left_idx]]
         return left_idx, right_idx
     if metrics.recording():
@@ -194,7 +194,7 @@ def _verified_join(plan, ix, lo, counts, how: str):
         metrics.count("join.verify.collisions", int(li.shape[0]) - kept)
         if how in ("inner", "left"):
             metrics.observe("join.match_rows", kept)
-    sel = jnp.nonzero(eq, size=kept)[0]
+    sel = sized_nonzero(eq, kept)
     li, ri = li[sel], ri[sel]
     if how == "inner":
         return li, ri
@@ -203,13 +203,13 @@ def _verified_join(plan, ix, lo, counts, how: str):
     if how in ("semi", "anti"):
         m = has if how == "semi" else ~has
         k = syncs.scalar(jnp.sum(m))
-        return jnp.nonzero(m, size=k)[0]
+        return sized_nonzero(m, k)
     # left outer: verified pairs plus one null-extended row per unmatched
     # probe row, restored to probe-row-major order (the expansion tail's
     # output order) by a stable sort on the left index
     miss = ~has
     nm = syncs.scalar(jnp.sum(miss))
-    mi = jnp.nonzero(miss, size=nm)[0]
+    mi = sized_nonzero(miss, nm)
     left_idx = jnp.concatenate([li, mi])
     right_idx = jnp.concatenate([ri, jnp.full(nm, -1, jnp.int64)])
     order = jnp.argsort(left_idx, stable=True)

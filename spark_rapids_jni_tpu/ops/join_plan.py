@@ -61,8 +61,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
-from ..column import Column, Table, force_column
+from ..column import Column, Table, as_dict_column, force_column
 from ..utils import metrics, syncs
+from .filter import sized_nonzero
 
 DENSE_SPAN_FACTOR = 2
 DENSE_SPAN_FLOOR = 4096
@@ -552,6 +553,12 @@ def plan_keys(left_cols: Sequence[Column],
     enc_l, enc_r = [], []
     for lc, rc in zip(left_cols, right_cols):
         if lc.dtype.is_variable_width or rc.dtype.is_variable_width:
+            # DictColumn sides ride the dictionary-level shared encode —
+            # codes out, row bytes never read, and the int32 result keeps
+            # the key on the dense lane (see strings.encode_shared)
+            if (as_dict_column(lc) is not None
+                    or as_dict_column(rc) is not None):
+                metrics.count("join.dict_keys")
             lc, rc = strings.encode_shared([lc, rc])
         enc_l.append(lc)
         enc_r.append(rc)
@@ -725,7 +732,7 @@ def join_aggregate(left: Table, right: Table, left_on, right_on,
             if how == "inner":
                 m = counts > 0
                 k = syncs.scalar(jnp.sum(m))
-                li = jnp.nonzero(m, size=k)[0]
+                li = sized_nonzero(m, k)
                 ri = ix.row_ids[pos[li]]
                 cols = [_take_col(left[ci], li) if ci < nl
                         else _take_col(right[ci - nl], ri) for ci in needed]
@@ -750,7 +757,7 @@ def join_aggregate(left: Table, right: Table, left_on, right_on,
             if how == "inner":
                 m = counts > 0
                 k = syncs.scalar(jnp.sum(m))
-                li = jnp.nonzero(m, size=k)[0]
+                li = sized_nonzero(m, k)
                 w = counts.astype(jnp.int64)[li]
                 return _weighted_groupby(
                     [_take_col(left[ci], li) for ci in group_keys],

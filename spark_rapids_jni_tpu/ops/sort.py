@@ -33,9 +33,17 @@ def _order_by(table: Table, keys: Sequence[int],
 
     lanes = []
     # lexsort sorts by the LAST key first → feed keys in reverse priority
+    from ..column import as_dict_column
     for ki, asc, nf in reversed(list(zip(keys, ascending, nulls_first))):
         col = table[ki]
-        if col.dtype.id.name == "STRING":
+        if col.dtype.id.name == "STRING" and as_dict_column(col) is not None:
+            # dictionary fast path: one order-preserving rank lane replaces
+            # the whole byte-lane stack (equal strings ⇒ equal ranks, so
+            # ties — and lexsort stability — match the byte path exactly)
+            from . import strings
+            rank, _ = strings.dict_rank_codes(as_dict_column(col))
+            key_lanes = [~rank if not asc else rank]
+        elif col.dtype.id.name == "STRING":
             # u32 byte lanes + length tiebreak (see ops.strings), already in
             # increasing-priority order for lexsort
             from . import strings

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
-from ..column import Column
+from ..column import Column, DictColumn, as_dict_column
 from ..rowconv.convert import _segment_of  # marker-scatter + cumsum lookup
 
 
@@ -114,6 +114,49 @@ def sort_key_lanes(col: Column, descending: bool = False) -> list[jnp.ndarray]:
 # dictionary encode
 # ---------------------------------------------------------------------------
 
+def dict_rank_codes(dcol: DictColumn) -> tuple[jnp.ndarray, Column]:
+    """Order-preserving rank per row of a :class:`DictColumn` + the sorted
+    dictionary those ranks index.
+
+    Scan codes are in *parquet-dictionary* order, not lexicographic order;
+    sorts and sorted groupbys need ranks.  The re-encode runs over the
+    dictionary only (|dict| rows, memoized via the ``dictionary_encode``
+    memo), then one gather maps row codes → ranks — row bytes are never
+    touched.  Duplicate dictionary entries (merged multi-chunk dictionaries)
+    collapse onto one rank, so rank equality == string equality even there.
+    """
+    if dcol.sorted_dict:
+        return dcol.codes, dcol.dictionary
+    rank, uniq = dictionary_encode(dcol.dictionary)
+    nd = dcol.dictionary.num_rows
+    from ..utils import metrics
+    metrics.count("strings.dict.gather")
+    if nd == 0:
+        return jnp.zeros(dcol.codes.shape, jnp.int32), uniq
+    rows = rank.data[jnp.clip(dcol.codes, 0, nd - 1)]
+    return rows, uniq
+
+
+def _dict_predicate(col: Column, fn) -> Optional[Column]:
+    """Dictionary fast path for per-row string predicates: evaluate ``fn``
+    once per dictionary entry (|dict| rows, typically 100-100k× smaller than
+    the table), then gather the boolean by code.  Returns None when ``col``
+    carries no dictionary (caller falls through to the byte-matrix path)."""
+    d = as_dict_column(col)
+    if d is None:
+        return None
+    from ..utils import metrics
+    metrics.count("strings.dict.predicate")
+    nd = d.dictionary.num_rows
+    if nd == 0:
+        bits = jnp.zeros(d.codes.shape, bool)
+    else:
+        dmask = fn(d.dictionary)           # BOOL8 over the dictionary
+        bits = (dmask.data != 0)[jnp.clip(d.codes, 0, nd - 1)]
+    metrics.count("strings.dict.gather")
+    return _as_bool_column(bits, d.validity)
+
+
 def dictionary_encode(col: Column) -> tuple[Column, Column]:
     """Order-preserving dense codes: (codes int32 column, dictionary column).
 
@@ -122,7 +165,21 @@ def dictionary_encode(col: Column) -> tuple[Column, Column]:
     dictionary column directly.  Null rows encode as the zeroed byte string
     (one shared code) with validity carried through — equality on
     (code, validity) pairs equals Spark's null-aware key equality.
+
+    A :class:`DictColumn` input re-encodes through its dictionary (see
+    :func:`dict_rank_codes`) — the dense-code consumers (groupby keys,
+    string join keys, window partitions) get the fast path with no byte
+    materialization and no byte-matrix sort over the full table.
     """
+    d = as_dict_column(col)
+    if d is not None:
+        rows, uniq = dict_rank_codes(d)
+        if d.validity is not None:
+            # mirror the materialized path: null rows collapse onto the
+            # lowest code so sorted-group order can't depend on the stale
+            # code a null slot happens to hold
+            rows = jnp.where(d.validity, rows, 0)
+        return Column(T.int32, rows, validity=d.validity), uniq
     n = col.num_rows
     if n == 0:
         return (Column(T.int32, jnp.zeros(0, jnp.int32)),
@@ -181,7 +238,34 @@ def dictionary_encode(col: Column) -> tuple[Column, Column]:
 
 def encode_shared(cols: Sequence[Column]) -> list[Column]:
     """Encode several string columns against ONE shared dictionary, so codes
-    compare/equate across columns (the equi-join enabler)."""
+    compare/equate across columns (the equi-join enabler).
+
+    :class:`DictColumn` inputs contribute their *dictionaries* (small) to
+    the shared encode instead of their rows, then translate row codes with
+    one gather — a string equi-join between two dict-scanned columns costs
+    an encode over the union of dictionaries, not over both tables.  Mixed
+    dict/plain inputs compose: the plain side is encoded at full size as
+    before, against the same shared dictionary.
+    """
+    dicts = [as_dict_column(c) for c in cols]
+    if any(d is not None for d in dicts):
+        from ..utils import metrics
+        parts = [d.dictionary if d is not None else c
+                 for c, d in zip(cols, dicts)]
+        shared = encode_shared(parts)      # all plain now → base path below
+        out = []
+        for c, d, s in zip(cols, dicts, shared):
+            if d is None:
+                out.append(s)
+                continue
+            metrics.count("strings.dict.gather")
+            nd = d.dictionary.num_rows
+            rows = (s.data[jnp.clip(d.codes, 0, nd - 1)] if nd
+                    else jnp.zeros(d.codes.shape, jnp.int32))
+            if d.validity is not None:
+                rows = jnp.where(d.validity, rows, 0)
+            out.append(Column(T.int32, rows, validity=d.validity))
+        return out
     sizes = [c.num_rows for c in cols]
     chars = jnp.concatenate([c.data for c in cols]) if any(
         c.data.shape[0] for c in cols) else jnp.zeros(0, jnp.uint8)
@@ -223,6 +307,9 @@ def equal_to(a: Column, b: Column) -> Column:
 
 def equal_to_scalar(col: Column, value: str | bytes) -> Column:
     """Column == scalar → BOOL8 column (null rows stay null)."""
+    hit = _dict_predicate(col, lambda u: equal_to_scalar(u, value))
+    if hit is not None:
+        return hit
     payload = value.encode("utf-8") if isinstance(value, str) else bytes(value)
     lens = _lengths(col)
     mat, _ = byte_matrix(col, max(len(payload), 1))
@@ -240,6 +327,9 @@ def equal_to_scalar(col: Column, value: str | bytes) -> Column:
 def upper(col: Column) -> Column:
     """ASCII uppercase (the reference's unicode_to_lower analog operates
     ASCII-per-byte for pruning too, NativeParquetJni.cpp:45)."""
+    d = as_dict_column(col)
+    if d is not None:   # elementwise ⇒ transform the dictionary, keep codes
+        return DictColumn(d.codes, upper(d.dictionary), d.validity)
     c = col.data
     is_lower = (c >= 97) & (c <= 122)
     return Column(T.string, jnp.where(is_lower, c - 32, c), col.offsets,
@@ -248,6 +338,9 @@ def upper(col: Column) -> Column:
 
 def lower(col: Column) -> Column:
     """ASCII lowercase."""
+    d = as_dict_column(col)
+    if d is not None:
+        return DictColumn(d.codes, lower(d.dictionary), d.validity)
     c = col.data
     is_upper = (c >= 65) & (c <= 90)
     return Column(T.string, jnp.where(is_upper, c + 32, c), col.offsets,
@@ -258,6 +351,10 @@ def substring(col: Column, start: int, length: Optional[int] = None) -> Column:
     """0-based byte substring [start, start+length) of every row."""
     if start < 0:
         raise ValueError("substring start must be >= 0")
+    d = as_dict_column(col)
+    if d is not None:
+        return DictColumn(d.codes, substring(d.dictionary, start, length),
+                          d.validity)
     lens = _lengths(col)
     new_lens = jnp.maximum(lens - start, 0)
     if length is not None:
@@ -526,6 +623,9 @@ def _search_matrix(col: Column, min_width: int):
 def contains(col: Column, pat: str | bytes) -> Column:
     """True where the row contains ``pat`` (Spark ``contains`` / LIKE
     '%pat%'); empty pattern matches everything; null rows stay null."""
+    hit = _dict_predicate(col, lambda u: contains(u, pat))
+    if hit is not None:
+        return hit
     pat = pat.encode() if isinstance(pat, str) else bytes(pat)
     mat, lens = _search_matrix(col, len(pat))
     return _as_bool_column(_match_at(mat, lens, pat).any(axis=1),
@@ -533,12 +633,18 @@ def contains(col: Column, pat: str | bytes) -> Column:
 
 
 def starts_with(col: Column, pat: str | bytes) -> Column:
+    hit = _dict_predicate(col, lambda u: starts_with(u, pat))
+    if hit is not None:
+        return hit
     pat = pat.encode() if isinstance(pat, str) else bytes(pat)
     mat, lens = _search_matrix(col, len(pat))
     return _as_bool_column(_match_at(mat, lens, pat)[:, 0], col.validity)
 
 
 def ends_with(col: Column, pat: str | bytes) -> Column:
+    hit = _dict_predicate(col, lambda u: ends_with(u, pat))
+    if hit is not None:
+        return hit
     pat = pat.encode() if isinstance(pat, str) else bytes(pat)
     mat, lens = _search_matrix(col, len(pat))
     hits = _match_at(mat, lens, pat)
@@ -556,6 +662,9 @@ def like(col: Column, pattern: str) -> Column:
     earliest-match scan per piece; the number of pieces is tiny and static,
     so the whole predicate stays a short chain of fused compares.
     """
+    hit = _dict_predicate(col, lambda u: like(u, pattern))
+    if hit is not None:
+        return hit
     pat = pattern.encode()
     pieces = pat.split(b"%")
     anchored_start = not pattern.startswith("%")

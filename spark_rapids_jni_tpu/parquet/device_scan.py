@@ -38,7 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
-from ..column import Column, Table
+from ..column import Column, DictColumn, Table
 from ..utils import metrics
 from ..utils.tracing import traced
 from . import decode as D
@@ -111,6 +111,9 @@ def _walk_chunk_raw(file_bytes: bytes, chunk, max_def: int, max_rep: int,
         header, raw = stream.next_page()
         ptype = header.get(D.PH.TYPE)
         usize = header.get(D.PH.UNCOMPRESSED_SIZE)
+        if metrics.recording() and ptype in (D.PAGE_DATA, D.PAGE_DICTIONARY):
+            metrics.count("parquet.pages.dict" if ptype == D.PAGE_DICTIONARY
+                          else "parquet.pages.data")
         if ptype == D.PAGE_DICTIONARY:
             dph = header.get(D.PH.DICT_PAGE)
             data = D._decompress(raw, codec, usize)
@@ -560,9 +563,27 @@ def _build_dstr(statics, args):
     return _dict_str_chars(geom, dictmat, dict_lens, idx, valid)
 
 
+def _build_dcode(statics, args):
+    """Dictionary-string CODES column body: def-level expansion of the RLE
+    index stream to one int32 code per output row (null slots hold 0) —
+    the whole string decode when the scan keeps the dictionary
+    (:class:`DictColumn` output; bytes materialize at the output boundary,
+    if ever)."""
+    (has_valid,) = statics
+    idx = args[0]
+    valid = args[1] if has_valid else None
+    if valid is None:
+        return idx.astype(jnp.int32)
+    pos = jnp.clip(jnp.cumsum(valid.astype(jnp.int32)) - 1, 0,
+                   max(int(idx.shape[0]) - 1, 0))
+    filled = idx[pos] if idx.shape[0] else jnp.zeros_like(pos)
+    return jnp.where(valid, filled, 0).astype(jnp.int32)
+
+
 _BUILDERS = {"plain": _build_plain, "flba": _build_flba,
              "bool": _build_bool, "dict": _build_dict,
-             "pstr": _build_pstr, "dstr": _build_dstr}
+             "pstr": _build_pstr, "dstr": _build_dstr,
+             "dcode": _build_dcode}
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
@@ -575,6 +596,15 @@ def _decode_file_jit(plan, arrays):
         outs.append(_BUILDERS[key](statics, arrays[i:i + k]))
         i += k
     return tuple(outs)
+
+
+def _dict_strings_enabled() -> bool:
+    """SRJT_DICT_STRINGS: keep dictionary-encoded string columns as
+    :class:`DictColumn` codes (default on; 0/off reverts to eager
+    materialization for differential testing)."""
+    import os
+    return os.environ.get("SRJT_DICT_STRINGS", "1").lower() not in (
+        "0", "off")
 
 
 def _scan_dict_str(parts, jvalid, n_total: int):
@@ -633,7 +663,9 @@ def _scan_dict_str(parts, jvalid, n_total: int):
     total_chars = int(dict_offs[-1])
     Lmax = int(lens.max(initial=0))
     Lw = xpack._bucket(max(-(-Lmax // 4), 1), 4)
-    if Lw > 512:
+    if Lw > 512 and not _dict_strings_enabled():
+        # the entry-width cap guards the padded [Ds, Lw] matrix of the
+        # materializing path only — the codes path never builds it
         return xpack._reject("dict_str_entry_len", Lw=Lw)
     if total_chars:
         geom_sg = xpack.plan_segmented_gather(starts, lens, dict_offs)
@@ -645,6 +677,25 @@ def _scan_dict_str(parts, jvalid, n_total: int):
             jnp.asarray(dict_offs.astype(np.int32)))
     else:
         chars_dict = jnp.zeros(0, jnp.uint8)
+
+    if _dict_strings_enabled():
+        # DICTIONARY FAST PATH (default): stop here.  The column stays as
+        # int32 codes + the contiguous dictionary just built — no padded
+        # row matrix, no packing-geometry sync, no chars stream.  Bytes
+        # materialize lazily at the output boundary (DictColumn), and
+        # predicates/joins/groupbys/sorts run on the codes.
+        from ..utils import hostcache
+        doffs32 = jnp.asarray(dict_offs.astype(np.int32))
+        hostcache.seed(doffs32, dict_offs.astype(np.int64))
+        dict_col = Column(T.string, chars_dict, doffs32)
+        metrics.count("plan.scan.dict_cols")
+        statics = (jvalid is not None,)
+        args = (idx,) + ((jvalid,) if jvalid is not None else ())
+
+        def assemble_codes(out):
+            return DictColumn(out, dict_col, jvalid)
+        return ("dcode", statics, args, assemble_codes)
+
     g = 8
     gidx = np.minimum(np.arange(0, Ds + g, g), Ds)
     span = int((dict_offs[gidx[1:]] - dict_offs[gidx[:-1]]).max(initial=1))
@@ -854,45 +905,73 @@ def stage_column_device(file_bytes: bytes, chunks, leaf):
 
 
 def _chunk_minmax(chunk, leaf):
-    """(min, max) from a column chunk's footer Statistics, or None when
-    the stats are absent/undecodable.  Only INT32/INT64 physical types —
-    the surrogate-key/date-dimension shapes row-group pruning targets."""
+    """(min, max) bounds from a column chunk's footer Statistics, or None
+    when the stats are absent/undecodable.  INT32/INT64 decode to ints,
+    BYTE_ARRAY returns raw bytes bounds (unsigned lexicographic — the
+    UTF8 logical order), FLBA DECIMAL decodes big-endian two's-complement
+    to the unscaled int the runtime predicate also compares against.
+
+    BYTE_ARRAY/FLBA read ONLY the logical ``min_value``/``max_value``
+    fields — the deprecated MIN/MAX pair used signed (or undefined) byte
+    order and cannot be trusted for these types.  Writers may truncate
+    the logical bounds (min rounded down, max rounded up): they remain
+    valid BOUNDS, which is all a disjointness test needs."""
     md = chunk.get(D.CC.META_DATA)
     st = md.get(D.CMD.STATISTICS)
     if st is None:
         return None
     phys = leaf.phys
-    if phys == D.PT_INT32:
-        fmt, size = "<i", 4
-    elif phys == D.PT_INT64:
-        fmt, size = "<q", 8
+    if phys in (D.PT_INT32, D.PT_INT64):
+        fmt, size = ("<i", 4) if phys == D.PT_INT32 else ("<q", 8)
+
+        def dec(v):
+            # explicit None check: b"\x00..." is a perfectly valid
+            # (falsy-looking) PLAIN-encoded bound
+            if v is None or not isinstance(v, (bytes, bytearray)) \
+                    or len(v) != size:
+                return None
+            return _struct.unpack(fmt, bytes(v))[0]
+
+        mn = dec(st.get(D.ST.MIN_VALUE))
+        if mn is None:
+            mn = dec(st.get(D.ST.MIN))
+        mx = dec(st.get(D.ST.MAX_VALUE))
+        if mx is None:
+            mx = dec(st.get(D.ST.MAX))
+    elif phys == D.PT_BYTE_ARRAY:
+        mn = st.get(D.ST.MIN_VALUE)
+        mx = st.get(D.ST.MAX_VALUE)
+        mn = bytes(mn) if isinstance(mn, (bytes, bytearray)) else None
+        mx = bytes(mx) if isinstance(mx, (bytes, bytearray)) else None
+    elif phys == D.PT_FIXED_LEN_BYTE_ARRAY:
+        try:
+            if not leaf.logical_dtype().is_decimal:
+                return None
+        except Exception:
+            return None
+        width = leaf.type_len
+
+        def dec(v):
+            if not isinstance(v, (bytes, bytearray)) \
+                    or (width and len(v) != width):
+                return None
+            return int.from_bytes(bytes(v), "big", signed=True)
+
+        mn = dec(st.get(D.ST.MIN_VALUE))
+        mx = dec(st.get(D.ST.MAX_VALUE))
     else:
         return None
-
-    def dec(v):
-        # explicit None check: b"\x00..." is a perfectly valid (falsy-
-        # looking) PLAIN-encoded bound
-        if v is None or not isinstance(v, (bytes, bytearray)) \
-                or len(v) != size:
-            return None
-        return _struct.unpack(fmt, bytes(v))[0]
-
-    mn = dec(st.get(D.ST.MIN_VALUE))
-    if mn is None:
-        mn = dec(st.get(D.ST.MIN))
-    mx = dec(st.get(D.ST.MAX_VALUE))
-    if mx is None:
-        mx = dec(st.get(D.ST.MAX))
     if mn is None or mx is None:
         return None
     return mn, mx
 
 
-def _group_disjoint(mn: int, mx: int, op: str, val: int) -> bool:
+def _group_disjoint(mn, mx, op: str, val) -> bool:
     """True when NO value in [mn, mx] can satisfy ``col <op> val`` — the
-    row group provably contains no matching rows.  Null rows need no
-    consideration: planner predicates fail nulls, and parquet min/max
-    statistics ignore them."""
+    row group provably contains no matching rows.  Works for any totally
+    ordered bound type (int bounds vs int literal, bytes bounds vs bytes
+    literal).  Null rows need no consideration: planner predicates fail
+    nulls, and parquet min/max statistics ignore them."""
     if op == "eq":
         return val < mn or val > mx
     if op == "lt":
@@ -908,9 +987,11 @@ def _group_disjoint(mn: int, mx: int, op: str, val: int) -> bool:
 
 def _prune_row_groups(groups_list, leaves, names, conds):
     """Indices of row groups that may contain matching rows.  ``conds``
-    is a list of ``(column_name, op, int_value)`` conjuncts (planner
-    contract: ALL must hold, so any single disjoint conjunct drops the
-    group).  Groups without usable statistics are always kept."""
+    is a list of ``(column_name, op, value)`` conjuncts with int or bytes
+    values (planner contract: ALL must hold, so any single disjoint
+    conjunct drops the group).  Groups without usable statistics — or
+    whose statistic type does not match the literal type — are always
+    kept."""
     name_to_idx = {n: i for i, n in enumerate(names)}
     kept = []
     for gi, rg in enumerate(groups_list):
@@ -923,6 +1004,8 @@ def _prune_row_groups(groups_list, leaves, names, conds):
             mm = _chunk_minmax(chunks[ci], leaves[ci])
             if mm is None:
                 continue
+            if isinstance(val, bytes) != isinstance(mm[0], bytes):
+                continue    # literal/statistic type mismatch: keep group
             if _group_disjoint(mm[0], mm[1], op, val):
                 drop = True
                 break

@@ -100,29 +100,48 @@ class FileCatalog:
         return t, cols
 
 
+def _rowgroup_literal(v):
+    """A literal usable for footer min/max pruning, or None.  Ints prune
+    INT32/INT64 (and int-backed decimal) chunks; strings pass as UTF-8
+    bytes and prune BYTE_ARRAY chunks (parquet's UTF8 logical order IS
+    unsigned byte order, so Python bytes comparison matches)."""
+    if hasattr(v, "item"):
+        v = v.item()
+    if isinstance(v, bool):
+        return None
+    if isinstance(v, int):
+        return v
+    if isinstance(v, str):
+        return v.encode("utf-8")
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v)
+    return None
+
+
 def rowgroup_conditions(expr: Optional[ir.Expr]):
-    """Extract ``(column, op, int_value)`` conditions the parquet scanner
-    can test against footer min/max statistics.  Only integer comparisons
-    qualify; anything else is simply not offered for pruning (the full
-    predicate still runs as a mask after decode)."""
+    """Extract ``(column, op, value)`` conditions the parquet scanner can
+    test against footer min/max statistics.  Integer and string
+    comparisons qualify (strings travel as UTF-8 bytes); anything else is
+    simply not offered for pruning (the full predicate still runs as a
+    mask after decode)."""
     conds = []
     for c in ir.conjuncts(expr):
         if (isinstance(c, ir.Cmp) and isinstance(c.left, ir.Col)
                 and isinstance(c.right, ir.Lit)
                 and c.op in ("==", "<", "<=", ">", ">=")):
-            v = c.right.value
-            if hasattr(v, "item"):
-                v = v.item()
-            if isinstance(v, int) and not isinstance(v, bool):
+            v = _rowgroup_literal(c.right.value)
+            if v is not None:
                 op = {"==": "eq", "<": "lt", "<=": "le", ">": "gt",
                       ">=": "ge"}[c.op]
                 conds.append((c.left.name, op, v))
         elif isinstance(c, ir.Between) and isinstance(c.col, ir.Col):
-            if isinstance(c.lo, int) and not isinstance(c.lo, bool):
-                conds.append((c.col.name, "ge", c.lo))
-            if isinstance(c.hi, int) and not isinstance(c.hi, bool):
+            lo = _rowgroup_literal(c.lo)
+            hi = _rowgroup_literal(c.hi)
+            if lo is not None:
+                conds.append((c.col.name, "ge", lo))
+            if hi is not None:
                 conds.append((c.col.name, "lt" if c.hi_strict else "le",
-                              c.hi))
+                              hi))
     return conds or None
 
 

@@ -41,7 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
-from ..column import Column, Table
+from ..column import Column, DictColumn, Table, as_dict_column, force_column
 from ..faultinj import fault_site
 from ..utils import bitmask, metrics
 from ..utils.tracing import traced
@@ -1005,6 +1005,45 @@ def _slice_column(col: Column, lo: int, hi: int) -> Column:
         hostcache.seed(offs, rebased)
         return Column(col.dtype, col.data[clo:chi], offs, v)
     return Column(col.dtype, col.data[lo:hi], validity=v)
+
+
+# --- dictionary-codes passthrough (dict string fast path) -------------------
+#
+# A DictColumn reaching convert_to_rows materializes its bytes — correct
+# (JCUDF rows must carry the strings) but back on the 0.6 GB/s variable-
+# width cliff.  When BOTH endpoints speak this engine (shuffle, spill,
+# cache), ship the CODES through the fixed-width path instead and send the
+# tiny dictionaries out of band: string columns transcode at int32 speed.
+
+def dict_encode_for_rows(table: Table) -> tuple[Table, dict[int, Column]]:
+    """Swap every dict string column for its int32 codes column.
+
+    Returns ``(codes_table, dicts)`` where ``dicts`` maps column index →
+    dictionary Column.  With every string column dict-encoded the table
+    becomes fixed-width-only and ``convert_to_rows`` takes the constant-
+    stride JCUDF path; :func:`restore_dict_columns` re-attaches the
+    dictionaries after ``convert_from_rows`` on the far side."""
+    dicts: dict[int, Column] = {}
+    cols: list[Column] = []
+    for i, c in enumerate(table.columns):
+        d = as_dict_column(c)
+        if d is not None:
+            dicts[i] = d.dictionary
+            cols.append(Column(T.int32, d.codes, validity=d.validity))
+        else:
+            cols.append(c)
+    if dicts:
+        metrics.count("rowconv.dict_cols", len(dicts))
+    return Table(cols), dicts
+
+
+def restore_dict_columns(table: Table, dicts: dict[int, Column]) -> Table:
+    """Inverse of :func:`dict_encode_for_rows` after a row round trip."""
+    cols = list(table.columns)
+    for i, dcol in dicts.items():
+        c = force_column(cols[i])
+        cols[i] = DictColumn(c.data.astype(jnp.int32), dcol, c.validity)
+    return Table(cols)
 
 
 @traced("convert_from_rows")

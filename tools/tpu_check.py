@@ -267,6 +267,58 @@ def check_dict_strings():
     record("dict strings device decode", ok)
 
 
+def check_dict_fast_path():
+    """Dictionary fast path on chip: the scan keeps codes (no byte
+    materialization), dictionary-aware predicates (evaluate once per
+    entry, gather the boolean by code) match a per-row byte-matrix
+    oracle, and code gathers match reference row selection."""
+    import io
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from spark_rapids_jni_tpu.column import DictColumn, Table
+    from spark_rapids_jni_tpu.ops import filter as F
+    from spark_rapids_jni_tpu.ops import strings as S
+    from spark_rapids_jni_tpu.parquet import device_scan
+    rng = np.random.default_rng(11)
+    n = 20_000
+    words = ["alpha", "alpaca", "beta", "betamax", "", "gamma-ray",
+             "alphabet"]
+    picks = rng.integers(0, len(words), n)
+    vals = [None if rng.random() < 0.1 else words[i] for i in picks]
+    t = pa.table({"s": pa.array(vals, pa.string())})
+    buf = io.BytesIO()
+    pq.write_table(t, buf, use_dictionary=True, row_group_size=8_000)
+    col = device_scan.scan_table(buf.getvalue()).columns[0]
+    record("dict fast path scan produces codes", isinstance(col, DictColumn))
+    if not isinstance(col, DictColumn):
+        return
+
+    # dictionary-aware predicate vs byte-matrix oracle (per-row evaluate)
+    def oracle(pred):
+        return np.array([bool(v is not None and pred(v)) for v in vals])
+
+    checks = [
+        ("equal", S.equal_to_scalar(col, "alpha"), oracle(lambda v: v == "alpha")),
+        ("starts_with", S.starts_with(col, "alp"), oracle(lambda v: v.startswith("alp"))),
+        ("like", S.like(col, "%eta%"), oracle(lambda v: "eta" in v)),
+    ]
+    for name, got, want in checks:
+        bits = np.asarray(got.data) != 0
+        if got.validity is not None:
+            bits = bits & np.asarray(got.validity)
+        record(f"dict predicate {name} vs oracle", np.array_equal(bits, want))
+    m = F.isin(col, ["beta", "gamma-ray", "absent"])
+    record("dict isin vs oracle",
+           np.array_equal(np.asarray(m), oracle(lambda v: v in ("beta", "gamma-ray"))))
+
+    # code gather: row selection without touching string bytes
+    idx = jnp.asarray(rng.integers(0, n, 4_000).astype(np.int32))
+    g = F.gather(Table([col]), idx).columns[0]
+    record("dict gather stays codes", isinstance(g, DictColumn))
+    want = [vals[i] for i in np.asarray(idx)]
+    record("dict gather rows", g.to_pylist() == want)
+
+
 def check_fixed_words():
     rng = np.random.default_rng(2)
     for name, schema in SCHEMAS.items():
@@ -519,6 +571,8 @@ def main():
         check_xpack_engines()
         print("dict strings:", flush=True)
         check_dict_strings()
+        print("dict fast path (codes + predicates):", flush=True)
+        check_dict_fast_path()
         print("fixed-width u32-words transcode:", flush=True)
         check_fixed_words()
         print("f64 bits<->values:", flush=True)
