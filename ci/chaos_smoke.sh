@@ -1,0 +1,152 @@
+#!/usr/bin/env bash
+# Chaos smoke — the fault-tolerance analog of ci/exec_smoke.sh: serve a
+# TPC-DS mix on a 4-replica (forced-host-device) pool, kill one replica
+# mid-run with a one-shot injected fatal fault, and assert the chaos
+# contract end to end: (1) ZERO failed requests — every response resolves
+# bit-identical to the serial oracle, (2) the victim quarantines and its
+# requests fail over (``incident:quarantine`` + ``incident:failover`` in
+# the flight ring, ``exec.failover.relocated`` counted), (3) the recovery
+# probe's canary re-admits the victim (``incident:recovery``, replica
+# healthy) within a bounded wait, and (4) device-targeted injection rules
+# (``device:`` + ``maxHits``) discriminate by replica scope.  Artifacts
+# land in target/chaos_smoke/.
+#
+# Usage: ci/chaos_smoke.sh [n_sales]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N_SALES="${1:-20000}"
+OUT=target/chaos_smoke
+mkdir -p "$OUT"
+
+echo "== chaos smoke: one-shot device kill over $N_SALES rows =="
+XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=4}" \
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+SPARK_RAPIDS_TPU_METRICS=1 SRJT_EXEC=1 \
+SRJT_SMOKE_OUT="$OUT" SRJT_SMOKE_N="$N_SALES" \
+python - <<'PYEOF'
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+out = os.environ["SRJT_SMOKE_OUT"]
+n_sales = int(os.environ["SRJT_SMOKE_N"])
+
+import numpy as np
+
+import jax
+
+from benchmarks import tpcds_data
+from spark_rapids_jni_tpu import exec as xc
+from spark_rapids_jni_tpu.faultinj import injector as finj
+from spark_rapids_jni_tpu.faultinj.injector import InjectedDeviceError
+from spark_rapids_jni_tpu.models import tpcds
+from spark_rapids_jni_tpu.utils import flight, metrics
+
+metrics.set_enabled(True)
+n_dev = min(4, jax.local_device_count())
+assert n_dev >= 2, "chaos smoke needs >=2 local devices"
+
+qnames = ["q3", "q42"]
+files = tpcds_data.generate(n_sales=n_sales, n_items=2000, n_stores=10,
+                            seed=5)
+tables = tpcds.load_tables(files)
+
+def canon(result):
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(result)]
+
+oracle = {q: canon(tpcds.QUERIES[q](tables)) for q in qnames}
+mix = [qnames[i % len(qnames)] for i in range(12)]
+inj = finj.get_injector()
+flight.reset()
+
+with xc.QueryScheduler(workers=n_dev, devices=n_dev, coalesce_ms=0,
+                       probe_base_s=0.05, probe_max_s=0.5) as sched:
+    # warm pass (also proves the fault-free multi-device path)
+    for q, tk in [(q, sched.submit(q, tpcds.QUERIES[q], tables))
+                  for q in mix]:
+        got = canon(tk.result(timeout=300))
+        assert all(np.array_equal(a, b)
+                   for a, b in zip(got, oracle[q])), "warm diverged"
+
+    # one-shot fatal fault: whichever replica serves next dies once
+    inj.load_dict({"seed": 3, "sites": {
+        "exec.dispatch": {"percent": 100,
+                          "injectionType": "device_error",
+                          "maxHits": 1}}})
+    inj.enable()
+    tickets = [(q, sched.submit(q, tpcds.QUERIES[q], tables))
+               for q in mix]
+    failed = 0
+    for q, tk in tickets:
+        got = canon(tk.result(timeout=300))
+        ok = len(got) == len(oracle[q]) and all(
+            np.array_equal(a, b) for a, b in zip(got, oracle[q]))
+        failed += not ok
+    assert failed == 0, f"{failed} requests failed under chaos"
+    assert inj.injected_count == 1, "fault did not fire exactly once"
+    relocated = sum(tk.relocations > 0 for _, tk in tickets)
+    assert relocated >= 1, "no request failed over"
+
+    # recovery: the probe's canary re-admits the victim
+    vi = next(i for i, r in enumerate(sched.replicas)
+              if r.resilient.fatal_count >= 1)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        snap = sched.ops_state()["replicas"][vi]
+        if snap["state"] == "healthy" and snap["recoveries"] >= 1:
+            break
+        time.sleep(0.05)
+    assert snap["state"] == "healthy" and snap["recoveries"] >= 1, snap
+    victim = snap["device"]
+    replicas = sched.ops_state()["replicas"]
+
+kinds = {e["kind"] for e in flight.events()
+         if e["kind"].startswith("incident:")}
+for want in ("incident:quarantine", "incident:failover",
+             "incident:recovery"):
+    assert want in kinds, f"missing {want} (have {sorted(kinds)})"
+counters = metrics.snapshot()["counters"]
+assert counters.get("exec.failover.relocated", 0) >= 1, counters
+assert counters.get("exec.failover.recovered", 0) >= 1, counters
+print(f"chaos OK: victim {victim}, {relocated} relocated, 0 failed, "
+      "quarantine+failover+recovery incidents present")
+
+# device-targeted rules discriminate by replica scope (maxHits one-shot)
+inj.load_dict({"seed": 1, "sites": {
+    "exec.dispatch": {"percent": 100, "injectionType": "device_error",
+                      "device": "cpu:1", "maxHits": 1}}})
+with finj.device_scope("cpu:0"):
+    assert inj.check("exec.dispatch") is None
+fired = False
+try:
+    with finj.device_scope("cpu:1"):
+        inj.check("exec.dispatch")
+except InjectedDeviceError:
+    fired = True
+assert fired, "device-targeted rule never fired in its scope"
+with finj.device_scope("cpu:1"):
+    assert inj.check("exec.dispatch") is None   # maxHits spent
+inj.disable()
+print("device targeting OK: fires only in scope, one-shot cap honored")
+
+summary = {
+    "devices": n_dev,
+    "requests": len(mix),
+    "failed_requests": 0,
+    "relocated": int(relocated),
+    "victim": victim,
+    "replicas": replicas,
+    "failover_counters": {k: int(v) for k, v in sorted(counters.items())
+                          if k.startswith("exec.failover.")
+                          or k == "exec.quarantined"},
+}
+with open(os.path.join(out, "summary.json"), "w") as f:
+    json.dump(summary, f, indent=1)
+print("wrote", os.path.join(out, "summary.json"))
+PYEOF
+
+echo "chaos smoke OK"

@@ -51,6 +51,8 @@ if [[ "${1:-}" != "--skip-tests" ]]; then
     python -m pytest tests/ -q
     echo "== exec smoke (serving runtime) =="
     ci/exec_smoke.sh
+    echo "== chaos smoke (fault-tolerant serving) =="
+    ci/chaos_smoke.sh
     echo "== plan smoke (query planner) =="
     ci/plan_smoke.sh
     echo "== stream smoke (incremental maintenance) =="

@@ -19,6 +19,11 @@ Parts, each its own module:
   request (``SRJT_EXEC_PLAN_CACHE_CAP``), with size-fingerprint plan
   sharing across refreshed same-shape data
   (``SRJT_EXEC_PLAN_SIZE_FP``) and vmapped batch execution.
+* :mod:`.placement` — per-device replica state (``SRJT_EXEC_DEVICES``):
+  each device its own executor lifecycle, admission ledger, and
+  identity-keyed placement cache; the scheduler routes whole requests to
+  replicas and fails them over across the quarantine → probation →
+  recovery lifecycle (``SRJT_EXEC_RECOVERY``).
 * :mod:`.prefetch` — double-buffered staging overlapping the next
   request's scan with current execution (``SRJT_EXEC_PREFETCH_DEPTH``).
 * :mod:`.slo` — rolling-window SLO watchdog over resolved requests
@@ -38,6 +43,7 @@ import os
 from .admission import AdmissionController, AdmissionGrant, request_bytes
 from .errors import (ExecDeadlineExceeded, ExecError, ExecQueueFull,
                      ExecShutdown)
+from .placement import Replica, build_replicas, device_name
 from .plan_cache import PlanCache
 from .prefetch import Prefetcher
 from .scheduler import QueryScheduler, QueryTicket
@@ -46,8 +52,9 @@ from .slo import SloWatchdog, thresholds_from_env
 __all__ = [
     "AdmissionController", "AdmissionGrant", "ExecDeadlineExceeded",
     "ExecError", "ExecQueueFull", "ExecShutdown", "PlanCache",
-    "Prefetcher", "QueryScheduler", "QueryTicket", "SloWatchdog",
-    "enabled", "request_bytes", "thresholds_from_env",
+    "Prefetcher", "QueryScheduler", "QueryTicket", "Replica",
+    "SloWatchdog", "build_replicas", "device_name", "enabled",
+    "request_bytes", "thresholds_from_env",
 ]
 
 

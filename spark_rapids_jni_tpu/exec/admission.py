@@ -126,12 +126,18 @@ class AdmissionGrant:
 
 
 class AdmissionController:
-    """The serving gate: bounded in-flight bytes with defer/degrade."""
+    """The serving gate: bounded in-flight bytes with defer/degrade.
 
-    def __init__(self, cap_bytes=None):
+    ``device`` labels this gate's ledger with the replica device it
+    fronts (multi-device scheduler: one controller per device, so
+    ``SRJT_EXEC_INFLIGHT_BYTES`` is a per-device cap and failover
+    re-admission charges the target device's ledger)."""
+
+    def __init__(self, cap_bytes=None, device: Optional[str] = None):
         if cap_bytes is None:
             cap_bytes = os.environ.get("SRJT_EXEC_INFLIGHT_BYTES")
         self.cap: Optional[int] = mbudget.parse_bytes(cap_bytes)
+        self.device = device
         self._cv = threading.Condition(threading.Lock())
         self._inflight = 0
         self._closed = False
@@ -172,7 +178,7 @@ class AdmissionController:
                         metrics.count("exec.admission.deferred")
                     flight.record("exec.admission.defer", rid=name,
                                   nbytes=n, inflight=self._inflight,
-                                  cap=cap)
+                                  cap=cap, device=self.device)
                 timeout = None
                 if deadline is not None:
                     timeout = deadline - time.monotonic()
@@ -185,11 +191,15 @@ class AdmissionController:
             self._inflight += hold
             if metrics.recording():
                 metrics.gauge("exec.inflight_bytes", self._inflight)
+                if self.device is not None:
+                    metrics.gauge(
+                        "exec.inflight_bytes."
+                        + self.device.replace(":", ""), self._inflight)
         if degrade:
             if metrics.recording():
                 metrics.count("exec.admission.degraded")
             flight.record("exec.admission.degrade", rid=name, nbytes=n,
-                          cap=cap)
+                          cap=cap, device=self.device)
         return AdmissionGrant(self, hold, degrade, deferred)
 
     def _release(self, nbytes: int) -> None:
@@ -197,4 +207,8 @@ class AdmissionController:
             self._inflight = max(self._inflight - int(nbytes), 0)
             if metrics.recording():
                 metrics.gauge("exec.inflight_bytes", self._inflight)
+                if self.device is not None:
+                    metrics.gauge(
+                        "exec.inflight_bytes."
+                        + self.device.replace(":", ""), self._inflight)
             self._cv.notify_all()

@@ -1,0 +1,223 @@
+"""Per-device replica state for the multi-device serving runtime.
+
+One :class:`Replica` per local device: the device handle, its own
+:class:`~..faultinj.resilience.ResilientExecutor` (fault lifecycle is per
+device — one chip's fatal fault must not quarantine the pool), its own
+:class:`~.admission.AdmissionController` (``SRJT_EXEC_INFLIGHT_BYTES`` is a
+PER-DEVICE arena cap; re-admission after failover charges the *target*
+device), and an identity-keyed placement cache.
+
+Placement model (data-parallel replication, ROADMAP item #1): requests are
+independent, so the scheduler routes whole requests to distinct devices and
+replicates their inputs.  Dimension build-indices and lookup tables are
+small, read-only, and identity-cached downstream (``utils.syncs`` memos key
+on buffer identity), so replicating them per device is cheap — and the
+placement cache here makes it *once* per (source buffer, device): repeat
+requests over the same resident tables reuse the same device-resident
+copies, which also keeps the plan cache's identity fingerprints stable per
+device (same placed buffers ⇒ same fingerprint ⇒ warm plan).
+
+The walker preserves column structure instead of flattening through the
+pytree protocol: a ``DictColumn`` is placed as codes + dictionary (its
+``tree_flatten`` would materialize the byte payload and defeat the dict
+fast path), a ``LazyColumn`` is forced first (placement is an output
+boundary for laziness — the copy must exist to move).
+
+JAX mechanics this relies on (verified): ``jax.device_put(x, dev)`` is
+bit-exact; computations follow committed inputs onto their device; mixing
+devices in one jit raises — hence the walker places a request's ENTIRE
+working set or nothing.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..faultinj import injector as finj
+from ..faultinj.resilience import ResilientExecutor
+from ..utils import flight, metrics
+from ..utils.hostcache import WeakIdMemo
+from .admission import AdmissionController
+
+
+def device_name(device) -> str:
+    """Canonical device label, e.g. ``"cpu:3"`` — the id the fault
+    injector's ``device:`` rules and incident snapshots use."""
+    return f"{device.platform}:{device.id}"
+
+
+class Replica:
+    """One device's serving state: executor lifecycle, admission ledger,
+    placement cache, and recovery-probe bookkeeping."""
+
+    def __init__(self, index: int, device, *, inflight_bytes=None,
+                 max_retries: int = 2, cache_bytes=None):
+        self.index = index
+        self.device = device
+        self.name = device_name(device)
+        self.resilient = ResilientExecutor(max_retries=max_retries,
+                                           device=self.name)
+        self.admission = AdmissionController(inflight_bytes,
+                                             device=self.name)
+        # source-buffer id → device-resident copy; weak on the source so
+        # a dropped table releases both copies
+        self._placed = WeakIdMemo(cap_bytes=cache_bytes)
+        self.ejected = False            # terminal: probes gave up
+        self.fail_streak = 0            # consecutive failed probes
+        self.next_probe_at = 0.0        # monotonic instant of next probe
+        self.probe_armed = False        # recovery probe owns this replica
+        self.active = 0                 # in-flight requests (gauge)
+        self.completed = 0              # served ok (per-device QPS)
+
+    # -- state ---------------------------------------------------------------
+
+    def state(self) -> str:
+        if self.ejected:
+            return "ejected"
+        return self.resilient.state
+
+    def serving(self) -> bool:
+        """True when this replica may pull new work off the queue."""
+        return not self.ejected and self.resilient.state == "healthy"
+
+    def recoverable(self) -> bool:
+        """True while the recovery probe still owns this replica's fate."""
+        return not self.ejected
+
+    def scope(self, pin_device: bool = True):
+        """The dispatch context for this replica: JAX default device (so
+        uncommitted intermediates land here) + the fault injector's device
+        scope (so ``device:``-targeted chaos rules can hit it).
+
+        ``pin_device=False`` sets only the injector scope.  The single-
+        device scheduler path uses it: ``jax.default_device`` is part of
+        jit's compilation-config context, so entering it around replay
+        RETRACES plans that were warmed outside the context — a hot-path
+        recompile per program for zero placement benefit when everything
+        already lives on the only device.  Multi-device dispatch pins
+        (warm-up and replay both run inside the same replica's scope, so
+        each per-device plan variant compiles exactly once)."""
+        import contextlib
+        import jax
+
+        @contextlib.contextmanager
+        def _scope():
+            with contextlib.ExitStack() as stack:
+                if pin_device:
+                    stack.enter_context(jax.default_device(self.device))
+                stack.enter_context(finj.device_scope(self.name))
+                yield
+        return _scope()
+
+    # -- placement -----------------------------------------------------------
+
+    def _place_array(self, a):
+        if a is None:
+            return None
+        hit = self._placed.get((a,))
+        if hit is not None:
+            if metrics.recording():
+                metrics.count("exec.place.hit")
+            return hit
+        import jax
+        out = jax.device_put(a, self.device)
+        self._placed.put((a,), out)
+        if metrics.recording():
+            metrics.count("exec.place.copy")
+            metrics.count("exec.place.bytes",
+                          int(getattr(a, "nbytes", 0) or 0))
+        return out
+
+    def _place_column(self, c):
+        from ..column import Column, DictColumn, force_column
+        c = force_column(c)
+        if isinstance(c, DictColumn):
+            return DictColumn(self._place_array(c.codes),
+                              self._place_column(c.dictionary),
+                              self._place_array(c.validity),
+                              sorted_dict=c.sorted_dict)
+        children = None
+        if c.children:
+            children = [self._place_column(ch) for ch in c.children]
+        return Column(c.dtype, self._place_array(c.data),
+                      self._place_array(c.offsets),
+                      self._place_array(c.validity), children)
+
+    def place(self, tables):
+        """``tables`` (dict / Table / Column / sequence nests) with every
+        payload buffer resident on this replica's device.  Identity-cached
+        per source buffer: repeat requests over resident tables reuse the
+        same device copies (stable plan-cache fingerprints per device)."""
+        from ..column import Column, Table
+        if tables is None:
+            return None
+        if isinstance(tables, dict):
+            return {k: self.place(v) for k, v in tables.items()}
+        if isinstance(tables, Table):
+            out = Table.__new__(Table)
+            out.columns = [self._place_column(c) for c in tables.columns]
+            return out
+        if isinstance(tables, Column):
+            return self._place_column(tables)
+        if isinstance(tables, (list, tuple)):
+            return type(tables)(self.place(v) for v in tables)
+        return tables
+
+    # -- recovery probe support ----------------------------------------------
+
+    def canary(self) -> None:
+        """One tiny device computation through the same dispatch path real
+        requests take (fault site + device scope), host-validated.  Raises
+        ``DeviceQuarantined`` when the device is still faulting."""
+        import jax.numpy as jnp
+
+        def _probe():
+            finj.get_injector().check("exec.dispatch")
+            n = 64
+            got = int(jnp.sum(jnp.arange(n, dtype=jnp.int32)))
+            if got != n * (n - 1) // 2:
+                raise RuntimeError(
+                    f"canary miscompare on {self.name}: {got}")
+            return got
+
+        with self.scope():
+            self.resilient.submit(_probe)
+
+    def schedule_probe(self, base_s: float, max_s: float, rng) -> None:
+        """Set the next probe instant with jittered exponential backoff in
+        the consecutive-failure streak."""
+        back = min(base_s * (2.0 ** self.fail_streak), max_s)
+        self.next_probe_at = time.monotonic() \
+            + back * (1.0 + 0.5 * rng.random())
+
+    def eject(self, reason: str = "probe failures") -> None:
+        """Terminal ejection: the probe gave up on this device."""
+        self.ejected = True
+        flight.incident("ejected", device=self.name, reason=reason,
+                        fail_streak=self.fail_streak,
+                        fatal_count=self.resilient.fatal_count)
+        if metrics.recording():
+            metrics.count("exec.failover.ejected")
+
+    def snapshot(self) -> dict:
+        """Ops-surface view (flight probes, ``ops_state``)."""
+        return {"device": self.name, "index": self.index,
+                "state": self.state(), "active": self.active,
+                "completed": self.completed,
+                "fail_streak": self.fail_streak,
+                "retries": self.resilient.retry_count,
+                "fatal_faults": self.resilient.fatal_count,
+                "recoveries": self.resilient.recovery_count,
+                "inflight_bytes": self.admission.inflight_bytes()}
+
+
+def build_replicas(n_devices: int, *, inflight_bytes=None,
+                   max_retries: int = 2) -> list[Replica]:
+    """Replicas over the first ``n_devices`` local devices (the shared
+    handle source ``parallel.mesh.local_devices``, so replica index ↔
+    mesh position agree)."""
+    from ..parallel.mesh import local_devices
+    devs = local_devices(n_devices)
+    return [Replica(i, d, inflight_bytes=inflight_bytes,
+                    max_retries=max_retries)
+            for i, d in enumerate(devs)]

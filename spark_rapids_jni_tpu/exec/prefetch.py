@@ -24,7 +24,7 @@ the buffer full, and skipped by the staging loop before loading
 one thing the double buffer must never hold while a live request loads
 inline.
 
-Counters: ``exec.prefetch.{hit,miss,rejected,deadline_evicted}``.
+Counters: ``exec.prefetch.{hit,miss,rejected,deadline_evicted,discarded}``.
 """
 
 from __future__ import annotations
@@ -156,11 +156,21 @@ class Prefetcher:
         return result
 
     def discard(self, key) -> None:
-        """Drop a staged slot without delivering it (cancelled request)."""
+        """Drop a staged slot without delivering it (cancelled, expired,
+        or failed-over request).  Every scheduler path that resolves a
+        loader-backed request WITHOUT taking its tables must call this —
+        an orphaned slot holds double-buffer capacity (and its spill
+        registration) until deadline eviction, which a slot staged
+        without a deadline never reaches."""
         with self._cv:
             slot = self._slots.pop(key, None)
-        if slot is not None and slot["done"].is_set() \
-                and slot["exc"] is None:
+            if slot is not None:
+                self._cv.notify_all()   # a slot freed; staging may resume
+        if slot is None:
+            return
+        if metrics.recording():
+            metrics.count("exec.prefetch.discarded")
+        if slot["done"].is_set() and slot["exc"] is None:
             _unregister_staged(slot["result"])
 
     def close(self) -> None:

@@ -1,16 +1,19 @@
 """Bounded-concurrency query scheduler: the serving runtime's front door.
 
 Shape follows the Spark side of the reference stack: a bounded task queue
-feeding a fixed worker pool over ONE shared device, with admission
-control deciding what may touch device memory when (SURVEY §1's
+feeding a fixed worker pool over N data-parallel device replicas, with
+admission control deciding what may touch device memory when (SURVEY §1's
 many-tasks-one-GPU discipline, rebuilt at query granularity).  One
 request's life:
 
-    submit ──queue (priority heap, bounded depth)── dequeue
-      → deadline check → prefetched tables (``exec/prefetch.py``)
-      → admission gate (``exec/admission.py``; may defer or degrade)
-      → plan cache (``exec/plan_cache.py``) under
-        ``memory.budget.query_budget`` + ``faultinj.ResilientExecutor``
+    submit ──queue (priority heap, bounded depth)── dequeue (a replica's
+      worker) → deadline check → prefetched tables (``exec/prefetch.py``)
+      → per-device admission gate (``exec/admission.py``; defer/degrade)
+      → placement (``exec/placement.py``: inputs replicated onto the
+        replica's device, identity-cached)
+      → plan cache (``exec/plan_cache.py``, device-keyed variant) under
+        ``memory.budget.query_budget`` + the replica's
+        ``faultinj.ResilientExecutor``
       → ticket resolves (result or typed error)
 
 Everything device-touching happens on the WORKER thread that dequeued
@@ -18,13 +21,44 @@ the request: capture runs, jit traces, and budget scopes are all
 thread-local-safe (``utils.syncs`` tape state and the query-budget stack
 are thread-local by construction), so workers never share partial state.
 
+**Multi-device placement** (``devices=N`` / ``SRJT_EXEC_DEVICES``,
+default 1): each of the first N local devices gets a
+:class:`~.placement.Replica` — its own ``ResilientExecutor`` (fault
+lifecycle is per device), its own ``AdmissionController``
+(``SRJT_EXEC_INFLIGHT_BYTES`` caps each device's in-flight bytes), and
+worker affinity (worker *i* serves replica *i* mod N).  Placement is
+least-loaded by construction: free workers pull from the shared priority
+heap, so work flows to whichever device has capacity; a non-serving
+replica's workers PARK and pull nothing.  Request inputs are replicated
+to the target device through an identity-keyed placement cache (small
+read-only dimension tables copy once, then every repeat request reuses
+the same device-resident buffers — which also keeps plan-cache identity
+fingerprints stable), and compiled plans key on a per-device variant
+(``d<k>``), so replicas never share traced buffers.
+
 Backpressure is typed, never silent: a full queue raises
 :class:`~.errors.ExecQueueFull` at submit, a missed deadline resolves
 the ticket with :class:`~.errors.ExecDeadlineExceeded`, shutdown drains
-to :class:`~.errors.ExecShutdown`.  Fault policy rides the shared
-:class:`~..faultinj.resilience.ResilientExecutor`: transient OOMs retry,
-a fatal device fault quarantines the whole pool (fail-fast on every
-later submit) — the plugin's "replace the executor" contract.
+to :class:`~.errors.ExecShutdown`.
+
+**Fault lifecycle — quarantine → probation → recovery → (ejection)**:
+transient OOMs retry in place with jittered exponential backoff; a fatal
+device fault quarantines THAT replica only.  The admission ladder
+generalizes defer → degrade → **relocate**: the quarantined replica's
+in-flight-failed and queued requests re-enqueue onto healthy replicas
+(bounded by ``SRJT_EXEC_RELOCATE_MAX`` hops, re-admitted on the target
+device's ledger, bit-identical results), counted by
+``exec.failover.relocated`` with a ``failover`` incident snapshot.  A
+background probe (``SRJT_EXEC_RECOVERY``, default on) retries the dead
+replica with jittered exponential backoff (``SRJT_EXEC_PROBE_BASE_S`` /
+``SRJT_EXEC_PROBE_MAX_S``): each probe moves the executor to probation
+and runs a host-validated canary through the real dispatch path —
+success re-admits the replica (``exec.failover.recovered`` + a
+``recovery`` incident), ``SRJT_EXEC_EJECT_AFTER`` consecutive failures
+permanently eject it (``exec.failover.ejected`` + an ``ejected``
+incident).  Only when NO replica can ever serve again does submit fail
+fast with ``DeviceQuarantined`` — the plugin's "replace the executor"
+contract, replacement included.
 
 **Cross-request coalescing** (``SRJT_EXEC_COALESCE_MS``, default 4 ms;
 0 disables): workers don't just interleave same-plan requests, they
@@ -59,11 +93,16 @@ shared program's cost is attributable to the requests that rode it.
 Deadline breaches, quarantines, and request failures dump incident
 snapshots; resolved outcomes feed the SLO watchdog (``exec/slo.py``).
 
-Knobs: ``SRJT_EXEC_WORKERS`` (default 4), ``SRJT_EXEC_QUEUE_DEPTH``
-(default 32), ``SRJT_EXEC_COALESCE_MS`` (default 4),
-``SRJT_EXEC_COALESCE_MAX`` (default 16), ``SRJT_EXEC_DEADLINE`` (default
-end-to-end timeout in seconds for requests submitted without one), plus
-the admission/prefetch/plan-cache knobs of the composed parts.
+Knobs: ``SRJT_EXEC_WORKERS`` (default 4; floored at the device count),
+``SRJT_EXEC_QUEUE_DEPTH`` (default 32), ``SRJT_EXEC_COALESCE_MS``
+(default 4), ``SRJT_EXEC_COALESCE_MAX`` (default 16),
+``SRJT_EXEC_DEADLINE`` (default end-to-end timeout in seconds for
+requests submitted without one), ``SRJT_EXEC_DEVICES`` (default 1),
+``SRJT_EXEC_RECOVERY`` (default 1), ``SRJT_EXEC_PROBE_BASE_S`` /
+``SRJT_EXEC_PROBE_MAX_S`` (default 0.05 / 2.0),
+``SRJT_EXEC_EJECT_AFTER`` (default 3), ``SRJT_EXEC_RELOCATE_MAX``
+(default: device count), plus the admission/prefetch/plan-cache knobs
+of the composed parts.
 Histograms: ``exec.queue_wait_ms``, ``exec.admission_wait_ms``,
 ``exec.exec_ms``, ``exec.e2e_ms``, ``exec.batch.size``,
 ``exec.batch.coalesce_wait_ms``, and the ``exec.stage.*`` attribution
@@ -76,17 +115,20 @@ import contextlib
 import heapq
 import itertools
 import os
+import random
 import threading
 import time
 from typing import Any, Callable, Optional
 
-from ..faultinj.resilience import DeviceQuarantined, ResilientExecutor
+from ..faultinj import injector as finj
+from ..faultinj.resilience import DeviceQuarantined
 from ..memory import budget as mbudget
 from ..models import compiled as C
 from ..utils import flight, metrics, structured_log
-from .admission import AdmissionController, request_bytes
+from .admission import request_bytes
 from .errors import (ExecDeadlineExceeded, ExecError, ExecQueueFull,
                      ExecShutdown)
+from .placement import Replica, build_replicas
 from .plan_cache import PlanCache
 from .prefetch import Prefetcher
 from .slo import SloWatchdog
@@ -100,7 +142,7 @@ class QueryTicket:
     recorder event and log line for this request carries."""
 
     __slots__ = ("name", "rid", "_done", "_result", "_exc", "timings",
-                 "degraded", "batch_rids")
+                 "degraded", "batch_rids", "device", "relocations")
 
     def __init__(self, name: str, rid: str = ""):
         self.name = name
@@ -111,6 +153,8 @@ class QueryTicket:
         self.timings: dict[str, float] = {}
         self.degraded = False
         self.batch_rids: Optional[list[str]] = None   # coalesced peers
+        self.device: Optional[str] = None             # replica that served
+        self.relocations = 0                          # failover hops
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -135,10 +179,12 @@ class QueryTicket:
 class _Request:
     __slots__ = ("name", "qfn", "tables", "loader", "priority", "deadline",
                  "nbytes", "compiled", "ticket", "t_submit", "seq", "ckey",
-                 "rid", "t_gather")
+                 "rid", "t_gather", "relocations", "relocatable")
 
     def __init__(self, **kw):
         self.t_gather = None        # set when pulled into a batch
+        self.relocations = 0        # failover hops so far
+        self.relocatable = True
         for k, v in kw.items():
             setattr(self, k, v)
 
@@ -157,7 +203,13 @@ class QueryScheduler:
                  prefetch: bool = True,
                  max_retries: int = 2,
                  coalesce_ms: Optional[float] = None,
-                 max_batch: Optional[int] = None):
+                 max_batch: Optional[int] = None,
+                 devices: Optional[int] = None,
+                 recovery: Optional[bool] = None,
+                 probe_base_s: Optional[float] = None,
+                 probe_max_s: Optional[float] = None,
+                 eject_after: Optional[int] = None,
+                 relocate_max: Optional[int] = None):
         if workers is None:
             workers = int(os.environ.get("SRJT_EXEC_WORKERS", "4"))
         if queue_depth is None:
@@ -166,23 +218,53 @@ class QueryScheduler:
             coalesce_ms = float(os.environ.get("SRJT_EXEC_COALESCE_MS", "4"))
         if max_batch is None:
             max_batch = int(os.environ.get("SRJT_EXEC_COALESCE_MAX", "16"))
-        self.workers = max(int(workers), 1)
+        if devices is None:
+            devices = int(os.environ.get("SRJT_EXEC_DEVICES", "1"))
+        if recovery is None:
+            recovery = os.environ.get("SRJT_EXEC_RECOVERY", "1").lower() \
+                not in ("0", "off", "false", "")
+        if probe_base_s is None:
+            probe_base_s = float(
+                os.environ.get("SRJT_EXEC_PROBE_BASE_S", "0.05"))
+        if probe_max_s is None:
+            probe_max_s = float(
+                os.environ.get("SRJT_EXEC_PROBE_MAX_S", "2.0"))
+        if eject_after is None:
+            eject_after = int(os.environ.get("SRJT_EXEC_EJECT_AFTER", "3"))
+        self.n_devices = max(int(devices), 1)
+        if relocate_max is None:
+            relocate_max = int(os.environ.get("SRJT_EXEC_RELOCATE_MAX",
+                                              str(self.n_devices)))
+        # every device needs at least one affine worker to serve at all
+        self.workers = max(int(workers), 1, self.n_devices)
         self.queue_depth = max(int(queue_depth), 1)
         self.coalesce_ms = max(float(coalesce_ms), 0.0)
         self.max_batch = max(int(max_batch), 1)
+        self.recovery = bool(recovery)
+        self.probe_base_s = max(float(probe_base_s), 1e-3)
+        self.probe_max_s = max(float(probe_max_s), self.probe_base_s)
+        self.eject_after = max(int(eject_after), 1)
+        self.relocate_max = max(int(relocate_max), 1)
         self.default_timeout_s: Optional[float] = None
         dl = os.environ.get("SRJT_EXEC_DEADLINE")
         if dl:
             self.default_timeout_s = float(dl)
-        self.admission = AdmissionController(inflight_bytes)
+        self.replicas: list[Replica] = build_replicas(
+            self.n_devices, inflight_bytes=inflight_bytes,
+            max_retries=max_retries)
+        # back-compat aliases: single-device callers (and the ops surface)
+        # see replica 0's gate and executor under the historical names
+        self.admission = self.replicas[0].admission
+        self.resilient = self.replicas[0].resilient
         self.plans = plan_cache if plan_cache is not None else PlanCache()
-        self.resilient = ResilientExecutor(max_retries=max_retries)
         self.prefetcher = Prefetcher() if prefetch else None
         self.slo = SloWatchdog()
         self._heap: list[tuple[int, int, _Request]] = []
         self._cv = threading.Condition(threading.Lock())
         self._seq = itertools.count()
         self._closed = False
+        self._probe_rng = random.Random(0x5e1f)
+        self._probe_stop = threading.Event()
         # black-box probes: an incident snapshot from ANY subsystem
         # carries the live serving state (last scheduler wins the names)
         flight.register_probe("scheduler.queue_depth", self.pending)
@@ -190,13 +272,23 @@ class QueryScheduler:
                               self.admission.inflight_bytes)
         flight.register_probe("scheduler.plan_cache", self.plans.stats)
         flight.register_probe("scheduler.slo", self.slo.status)
+        flight.register_probe(
+            "scheduler.replicas",
+            lambda: [rep.snapshot() for rep in self.replicas])
         metrics.start_http_server()    # no-op without SRJT_METRICS_PORT
         self._threads = [
             threading.Thread(target=self._worker, name=f"srjt-exec-{i}",
+                             args=(self.replicas[i % self.n_devices],),
                              daemon=True)
             for i in range(self.workers)]
         for t in self._threads:
             t.start()
+        self._probe_thread: Optional[threading.Thread] = None
+        if self.recovery:
+            self._probe_thread = threading.Thread(
+                target=self._recovery_loop, name="srjt-exec-probe",
+                daemon=True)
+            self._probe_thread.start()
 
     def pending(self) -> int:
         """Queued-but-undequeued request count (ops probe)."""
@@ -208,9 +300,11 @@ class QueryScheduler:
         queue depth, in-flight bytes, plan-cache stats, SLO status."""
         return {"queue_depth": self.pending(),
                 "workers": self.workers,
+                "devices": self.n_devices,
                 "inflight_bytes": self.admission.inflight_bytes(),
                 "inflight_cap": self.admission.cap,
                 "quarantined": self.resilient.quarantined,
+                "replicas": [rep.snapshot() for rep in self.replicas],
                 "plan_cache": self.plans.stats(),
                 "slo": self.slo.status()}
 
@@ -221,7 +315,8 @@ class QueryScheduler:
                priority: int = 0,
                timeout_s: Optional[float] = None,
                nbytes: Optional[int] = None,
-               compiled: bool = True) -> QueryTicket:
+               compiled: bool = True,
+               relocatable: bool = True) -> QueryTicket:
         """Enqueue ``qfn`` over ``tables`` (or over ``loader()``'s result,
         staged ahead of execution by the prefetcher).  Raises
         :class:`ExecQueueFull` at depth — the backpressure signal —
@@ -233,8 +328,12 @@ class QueryScheduler:
         the plan cache (eager execution)."""
         if tables is None and loader is None:
             raise ValueError("submit needs tables or a loader")
-        if self.resilient.quarantined:
-            raise DeviceQuarantined("executor is quarantined")
+        # fail fast only when no replica can EVER serve this request:
+        # with recovery on, a quarantined (non-ejected) replica still
+        # counts — the probe may re-admit it before the deadline
+        if not any(r.recoverable() if self.recovery else r.serving()
+                   for r in self.replicas):
+            raise DeviceQuarantined("every replica is quarantined")
         if timeout_s is None:
             timeout_s = self.default_timeout_s
         seq = next(self._seq)
@@ -256,7 +355,8 @@ class QueryScheduler:
             priority=int(priority),
             deadline=(now + timeout_s) if timeout_s is not None else None,
             nbytes=nbytes, compiled=compiled, ticket=ticket,
-            t_submit=now, seq=seq, ckey=ckey, rid=rid)
+            t_submit=now, seq=seq, ckey=ckey, rid=rid,
+            relocatable=relocatable)
         with self._cv:
             if self._closed:
                 raise ExecShutdown("scheduler is shut down")
@@ -276,8 +376,11 @@ class QueryScheduler:
                       timeout_s=timeout_s if timeout_s is not None else 0)
         if metrics.recording():
             metrics.count("exec.submitted")
-        if loader is not None and self.prefetcher is not None:
-            # overlap the next request's scan with current executions
+        if loader is not None and tables is None \
+                and self.prefetcher is not None:
+            # overlap the next request's scan with current executions.
+            # (tables-AND-loader submits must not stage: the serve path
+            # uses the tables directly and would orphan the slot)
             self.prefetcher.stage((req.name, req.seq), loader,
                                   deadline=req.deadline)
         return ticket
@@ -306,9 +409,11 @@ class QueryScheduler:
             metrics.count("stream.refresh.submitted")
         flight.record("stream.refresh.submit", view=v.name,
                       view_kind=v.kind, est_bytes=est)
+        # relocatable=False: the refresh closure mutates registry state,
+        # so a fault mid-refresh must surface, never silently re-run
         return self.submit(f"refresh:{v.name}", _refresh, tables={},
                            priority=priority, timeout_s=timeout_s,
-                           nbytes=est, compiled=False)
+                           nbytes=est, compiled=False, relocatable=False)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -327,14 +432,19 @@ class QueryScheduler:
             flight.record("exec.resolve", rid=req.rid, outcome="shutdown")
             req.ticket._resolve(exc=ExecShutdown(
                 f"scheduler shut down before {req.name!r} started"))
-        self.admission.close()
+        self._probe_stop.set()
+        for rep in self.replicas:
+            rep.admission.close()
         if self.prefetcher is not None:
             self.prefetcher.close()
         if wait:
             for t in self._threads:
                 t.join(timeout=30)
+            if self._probe_thread is not None:
+                self._probe_thread.join(timeout=5)
         for probe in ("scheduler.queue_depth", "scheduler.inflight_bytes",
-                      "scheduler.plan_cache", "scheduler.slo"):
+                      "scheduler.plan_cache", "scheduler.slo",
+                      "scheduler.replicas"):
             flight.unregister_probe(probe)
 
     def __enter__(self) -> "QueryScheduler":
@@ -345,25 +455,163 @@ class QueryScheduler:
 
     # -- worker loop ---------------------------------------------------------
 
-    def _worker(self) -> None:
+    def _worker(self, rep: Replica) -> None:
         while True:
+            req = None
+            batch = None
             with self._cv:
                 while not self._heap and not self._closed:
                     self._cv.wait()
                 if not self._heap:
                     return              # closed and drained
-                _, _, req = heapq.heappop(self._heap)
-                req.t_gather = time.monotonic()
-                batch = [req]
-                if req.ckey is not None:
-                    self._gather_locked(req.ckey, batch)
-            flight.record("exec.dequeue", rid=req.rid)
+                if not rep.serving():
+                    # parked: a quarantined/probation/ejected replica's
+                    # workers pull nothing — work flows to the healthy
+                    # replicas' workers instead.  Timed wait so recovery
+                    # (and close) edges are observed even without a
+                    # notify.
+                    self._cv.wait(timeout=0.05)
+                else:
+                    _, _, req = heapq.heappop(self._heap)
+                    req.t_gather = time.monotonic()
+                    batch = [req]
+                    if req.ckey is not None:
+                        self._gather_locked(req.ckey, batch)
+            if req is None:
+                continue
+            flight.record("exec.dequeue", rid=req.rid, device=rep.name)
             if req.ckey is not None:
                 self._coalesce_wait(req.ckey, batch)
             if len(batch) == 1:
-                self._serve(req)
+                self._serve(req, rep)
             else:
-                self._serve_batch(batch)
+                self._serve_batch(batch, rep)
+
+    # -- fault lifecycle: relocation + recovery probe ------------------------
+
+    def _variant(self, rep: Replica, degrade: bool) -> str:
+        """Plan-cache variant key: ambient modes (degraded sort engine)
+        composed with the serving device — replicas must never share a
+        traced program's captured buffers."""
+        parts = []
+        if degrade:
+            parts.append("sorted")
+        if self.n_devices > 1:
+            parts.append(f"d{rep.index}")
+        return "@".join(parts)
+
+    def _relocate(self, req: "_Request", tables, rep: Replica) -> bool:
+        """Fail a dying replica's request OVER instead of failing it:
+        re-enqueue (original submission order, so relocated requests stay
+        ahead of newer arrivals) for a healthy — or recoverable — replica
+        to pick up.  Re-admission naturally charges the target device's
+        ledger.  Returns False when the request must fail instead."""
+        if not req.relocatable or req.relocations >= self.relocate_max:
+            return False
+        if req.deadline is not None and time.monotonic() > req.deadline:
+            return False
+        targets = [r for r in self.replicas if r is not rep
+                   and (r.serving() or (self.recovery and r.recoverable()))]
+        if not targets and not (self.recovery and rep.recoverable()):
+            return False
+        req.relocations += 1
+        req.ticket.relocations = req.relocations
+        if tables is not None:
+            # carry the already-loaded working set: the target replica
+            # re-places it from the SOURCE buffers (identity cache), so
+            # nothing reloads and results stay bit-identical
+            req.tables = tables
+            req.loader = None
+        with self._cv:
+            if self._closed:
+                return False
+            heapq.heappush(self._heap, (req.priority, req.seq, req))
+            self._cv.notify_all()
+        if metrics.recording():
+            metrics.count("exec.failover.relocated")
+        flight.incident("failover", request_id=req.rid, query=req.name,
+                        device=rep.name, relocations=req.relocations,
+                        targets=[r.name for r in targets])
+        return True
+
+    def _on_quarantine(self, rep: Replica) -> None:
+        """A fatal fault just quarantined ``rep`` (or a submit hit the
+        already-quarantined executor): arm its recovery probe, or — when
+        nothing can ever recover — drain the queue so no request hangs
+        behind a permanently dead pool."""
+        if self.recovery and rep.recoverable():
+            with self._cv:
+                if rep.resilient.quarantined and not rep.probe_armed:
+                    rep.probe_armed = True
+                    rep.schedule_probe(self.probe_base_s, self.probe_max_s,
+                                       self._probe_rng)
+        self._drain_if_dead()
+
+    def _drain_if_dead(self) -> None:
+        """When NO replica can ever serve again, resolve every queued
+        request with ``DeviceQuarantined`` — queued work must fail fast,
+        not hang until its deadline behind permanently parked workers."""
+        if any(r.recoverable() if self.recovery else r.serving()
+               for r in self.replicas):
+            return
+        with self._cv:
+            dead = [r for _, _, r in self._heap]
+            self._heap.clear()
+            self._cv.notify_all()
+        for req in dead:
+            if self.prefetcher is not None and req.loader is not None:
+                self.prefetcher.discard((req.name, req.seq))
+            self._resolve_fail(
+                req, DeviceQuarantined("every replica is quarantined"),
+                "queue", incident_kind="quarantine")
+
+    def _recovery_loop(self) -> None:
+        while not self._probe_stop.wait(0.02):
+            now = time.monotonic()
+            for rep in self.replicas:
+                with self._cv:
+                    due = (rep.probe_armed and not rep.ejected
+                           and rep.resilient.quarantined
+                           and now >= rep.next_probe_at)
+                if due:
+                    self._probe(rep)
+
+    def _probe(self, rep: Replica) -> None:
+        """One recovery attempt: probation + canary.  Success re-admits
+        the replica; ``eject_after`` consecutive failures eject it."""
+        rep.resilient.recover()
+        flight.record("exec.failover.probe", device=rep.name,
+                      streak=rep.fail_streak)
+        try:
+            rep.canary()
+        except BaseException as e:
+            # still faulting (or the canary miscompared — treat a wrong
+            # answer exactly like a fault: the device cannot be trusted)
+            rep.resilient.fail_probation()
+            rep.fail_streak += 1
+            if metrics.recording():
+                metrics.count("exec.failover.probe_failed")
+            flight.record("exec.failover.probe_failed", device=rep.name,
+                          streak=rep.fail_streak, error=type(e).__name__)
+            if rep.fail_streak >= self.eject_after:
+                rep.eject()
+                with self._cv:
+                    rep.probe_armed = False
+                    self._cv.notify_all()
+                self._drain_if_dead()
+            else:
+                with self._cv:
+                    rep.schedule_probe(self.probe_base_s, self.probe_max_s,
+                                       self._probe_rng)
+            return
+        rep.fail_streak = 0
+        with self._cv:
+            rep.probe_armed = False
+            self._cv.notify_all()       # unpark this replica's workers
+        if metrics.recording():
+            metrics.count("exec.failover.recovered")
+        flight.incident("recovery", device=rep.name, canary="ok",
+                        recovery_count=rep.resilient.recovery_count)
 
     # -- coalescing ----------------------------------------------------------
 
@@ -431,14 +679,17 @@ class QueryScheduler:
             metrics.observe(f"exec.stage.{stage}_ms", seconds * 1e3)
 
     def _resolve_ok(self, req: "_Request", result, *,
-                    degraded: bool = False, deferred: bool = False) -> None:
+                    degraded: bool = False, deferred: bool = False,
+                    relocated: bool = False) -> None:
         e2e = req.ticket.timings.get(
             "e2e_s", time.monotonic() - req.t_submit)
         flight.record("exec.resolve", rid=req.rid, outcome="ok",
-                      e2e_ms=round(e2e * 1e3, 3), degraded=degraded)
+                      e2e_ms=round(e2e * 1e3, 3), degraded=degraded,
+                      device=req.ticket.device,
+                      relocations=req.relocations)
         self.slo.observe(req.name, e2e * 1e3, outcome="ok",
                          degraded=degraded, deferred=deferred,
-                         request_id=req.rid)
+                         relocated=relocated, request_id=req.rid)
         req.ticket._resolve(result=result)
 
     def _resolve_fail(self, req: "_Request", exc: BaseException,
@@ -487,7 +738,7 @@ class QueryScheduler:
             metrics.count("exec.batch.split", len(subs) - 1)
         return subs
 
-    def _serve_batch(self, batch: list) -> None:
+    def _serve_batch(self, batch: list, rep: Replica) -> None:
         """Serve a coalesced same-plan batch: per-request deadline sweep,
         one admission charge per cap-fitting sub-batch, one program
         launch through ``PlanCache.run_batched``."""
@@ -515,11 +766,11 @@ class QueryScheduler:
                 live.append(r)
         for sub, est in self._split_by_cap(live):
             if len(sub) == 1:
-                self._serve(sub[0])
+                self._serve(sub[0], rep)
             elif sub:
-                self._execute_batch(sub, est)
+                self._execute_batch(sub, est, rep)
 
-    def _execute_batch(self, batch: list, est: int) -> None:
+    def _execute_batch(self, batch: list, est: int, rep: Replica) -> None:
         name = batch[0].name
         rids = [r.rid for r in batch]
         for r in batch:
@@ -527,7 +778,7 @@ class QueryScheduler:
         deadlines = [r.deadline for r in batch if r.deadline is not None]
         try:
             t_adm = time.monotonic()
-            grant = self.admission.admit(
+            grant = rep.admission.admit(
                 est, name=f"{name}[x{len(batch)}]",
                 deadline=min(deadlines) if deadlines else None)
             adm_wait = time.monotonic() - t_adm
@@ -551,7 +802,7 @@ class QueryScheduler:
                         "admission", outcome="deadline",
                         incident_kind="deadline", batch=rids)
                 else:
-                    self._serve(r)
+                    self._serve(r, rep)
             return
         except ExecError as e:
             for r in batch:
@@ -570,24 +821,36 @@ class QueryScheduler:
             # construction; defensive fallback only
             grant.release()
             for r in batch:
-                self._serve(r)
+                self._serve(r, rep)
             return
         flight.record("exec.batch.launch", rid=batch[0].rid, batch=rids,
-                      size=len(batch), est_bytes=est)
+                      size=len(batch), est_bytes=est, device=rep.name)
         t0 = time.monotonic()
-        retries0 = self.resilient.retry_count
+        retries0 = rep.resilient.retry_count
+        variant = self._variant(rep, False)
+        rep.active += len(batch)
         try:
             with grant, structured_log.bound(batch_rids=",".join(rids)):
                 scope = mbudget.query_budget(
-                    name, batched=len(batch)) if mbudget.enabled() \
+                    name, batched=len(batch),
+                    device=rep.name if self.n_devices > 1 else None) \
+                    if mbudget.enabled() \
                     else metrics.span(f"query:{name}", batched=len(batch))
                 with scope, metrics.span("batch", size=len(batch),
-                                         members=",".join(rids)):
+                                         members=",".join(rids)), \
+                        rep.scope(pin_device=self.n_devices > 1):
+                    if self.n_devices > 1:
+                        member_tables = [rep.place(r.tables)
+                                         for r in batch]
+                    else:
+                        member_tables = [r.tables for r in batch]
+
                     def _run():
+                        finj.get_injector().check("exec.dispatch")
                         return self.plans.run_batched(
-                            name, batch[0].qfn,
-                            [r.tables for r in batch])
-                    outs = self.resilient.submit(_run)
+                            name, batch[0].qfn, member_tables,
+                            variant=variant)
+                    outs = rep.resilient.submit(_run)
                     t_disp = time.monotonic()
                     try:
                         import jax
@@ -600,12 +863,14 @@ class QueryScheduler:
                           batch=rids, exec_ms=round(dt * 1e3, 3))
             if metrics.recording():
                 metrics.observe("exec.batch.size", len(batch))
-                retried = self.resilient.retry_count - retries0
+                retried = rep.resilient.retry_count - retries0
                 if retried:
                     metrics.count("exec.retries", retried)
+            rep.completed += len(batch)
             for r, out in zip(batch, outs):
                 r.ticket.timings["exec_s"] = dt
                 r.ticket.timings["e2e_s"] = t_done - r.t_submit
+                r.ticket.device = rep.name
                 self._stage_obs(r.ticket, "dispatch", t_disp - t0)
                 self._stage_obs(r.ticket, "ready", t_done - t_disp)
                 if metrics.recording():
@@ -613,11 +878,18 @@ class QueryScheduler:
                     metrics.observe("exec.e2e_ms",
                                     (t_done - r.t_submit) * 1e3)
                     metrics.count("exec.completed")
-                self._resolve_ok(r, out, deferred=grant.deferred)
+                    metrics.count("exec.device."
+                                  + rep.name.replace(":", "")
+                                  + ".completed")
+                self._resolve_ok(r, out, deferred=grant.deferred,
+                                 relocated=r.relocations > 0)
         except DeviceQuarantined as e:
-            if metrics.recording():
-                metrics.count("exec.quarantined")
+            self._on_quarantine(rep)
             for r in batch:
+                if self._relocate(r, r.tables, rep):
+                    continue
+                if metrics.recording():
+                    metrics.count("exec.quarantined")
                 self._resolve_fail(r, e, "execute",
                                    incident_kind="quarantine", batch=rids)
         except BaseException as e:
@@ -627,8 +899,10 @@ class QueryScheduler:
                 self._resolve_fail(r, e, "execute",
                                    incident_kind="request_failed",
                                    batch=rids)
+        finally:
+            rep.active -= len(batch)
 
-    def _serve(self, req: _Request) -> None:
+    def _serve(self, req: _Request, rep: Replica) -> None:
         tk = req.ticket
         t_dq = time.monotonic()
         queue_wait = t_dq - req.t_submit
@@ -661,8 +935,8 @@ class QueryScheduler:
             est = req.nbytes if req.nbytes is not None \
                 else request_bytes(tables)
             t_adm = time.monotonic()
-            grant = self.admission.admit(est, name=req.rid or req.name,
-                                         deadline=req.deadline)
+            grant = rep.admission.admit(est, name=req.rid or req.name,
+                                        deadline=req.deadline)
             adm_wait = time.monotonic() - t_adm
             tk.timings["admission_wait_s"] = adm_wait
             self._stage_obs(tk, "admission", adm_wait)
@@ -684,7 +958,9 @@ class QueryScheduler:
             return
         tk.degraded = grant.degrade
         t0 = time.monotonic()
-        retries0 = self.resilient.retry_count
+        retries0 = rep.resilient.retry_count
+        variant = self._variant(rep, grant.degrade)
+        rep.active += 1
         try:
             with grant, structured_log.bound(request_id=req.rid):
                 # degraded admission: the dense engine's O(key-range)
@@ -702,20 +978,30 @@ class QueryScheduler:
                 # per-request overhead off the serving hot path
                 scope = mbudget.query_budget(
                     req.name, queue_wait_ms=round(queue_wait * 1e3, 3),
-                    degraded=grant.degrade) if mbudget.enabled() \
+                    degraded=grant.degrade,
+                    device=rep.name if self.n_devices > 1 else None) \
+                    if mbudget.enabled() \
                     else metrics.span(f"query:{req.name}",
                                       degraded=grant.degrade)
-                with ctx, scope:
+                with ctx, scope, \
+                        rep.scope(pin_device=self.n_devices > 1):
+                    # replicate the working set onto the serving device
+                    # (identity-cached; single-device serves in place)
+                    run_tables = rep.place(tables) \
+                        if self.n_devices > 1 else tables
+
                     def _run():
+                        finj.get_injector().check("exec.dispatch")
                         if req.compiled:
-                            # degraded plans cache under their own
-                            # variant: a dense-captured tape misaligns
-                            # under the forced sorted engine
+                            # degraded/per-device plans cache under their
+                            # own variant: a dense-captured tape
+                            # misaligns under the forced sorted engine,
+                            # and replicas never share traced buffers
                             return self.plans.run(
-                                req.name, req.qfn, tables,
-                                variant="sorted" if grant.degrade else "")
-                        return req.qfn(tables)
-                    result = self.resilient.submit(_run)
+                                req.name, req.qfn, run_tables,
+                                variant=variant)
+                        return req.qfn(run_tables)
+                    result = rep.resilient.submit(_run)
                     t_disp = time.monotonic()
                     # a response is delivered, not dispatched: JAX
                     # dispatch is async, so resolve tickets only when
@@ -729,6 +1015,7 @@ class QueryScheduler:
             t_done = time.monotonic()
             tk.timings["exec_s"] = t_done - t0
             tk.timings["e2e_s"] = t_done - req.t_submit
+            tk.device = rep.name
             self._stage_obs(tk, "dispatch", t_disp - t0)
             self._stage_obs(tk, "ready", t_done - t_disp)
             if metrics.recording():
@@ -736,20 +1023,28 @@ class QueryScheduler:
                                 tk.timings["exec_s"] * 1e3)
                 metrics.observe("exec.e2e_ms", tk.timings["e2e_s"] * 1e3)
                 metrics.count("exec.completed")
-                retried = self.resilient.retry_count - retries0
+                metrics.count("exec.device." + rep.name.replace(":", "")
+                              + ".completed")
+                retried = rep.resilient.retry_count - retries0
                 if retried:
                     metrics.count("exec.retries", retried)
+            rep.completed += 1
             self._resolve_ok(req, result, degraded=grant.degrade,
-                             deferred=grant.deferred)
+                             deferred=grant.deferred,
+                             relocated=req.relocations > 0)
         except DeviceQuarantined as e:
-            if metrics.recording():
-                metrics.count("exec.quarantined")
-            self._resolve_fail(req, e, "execute",
-                               incident_kind="quarantine",
-                               batch=tk.batch_rids)
+            self._on_quarantine(rep)
+            if not self._relocate(req, tables, rep):
+                if metrics.recording():
+                    metrics.count("exec.quarantined")
+                self._resolve_fail(req, e, "execute",
+                                   incident_kind="quarantine",
+                                   batch=tk.batch_rids)
         except BaseException as e:
             if metrics.recording():
                 metrics.count("exec.failed")
             self._resolve_fail(req, e, "execute",
                                incident_kind="request_failed",
                                batch=tk.batch_rids)
+        finally:
+            rep.active -= 1

@@ -15,8 +15,11 @@ Thresholds come from env (unset objectives are simply not evaluated)::
   SRJT_SLO_P50_MS / SRJT_SLO_P95_MS / SRJT_SLO_P99_MS
       latency objectives in milliseconds
   SRJT_SLO_ERROR_RATE / SRJT_SLO_DEADLINE_RATE /
-  SRJT_SLO_DEFER_RATE  / SRJT_SLO_DEGRADE_RATE
-      rate objectives in [0, 1]
+  SRJT_SLO_DEFER_RATE  / SRJT_SLO_DEGRADE_RATE /
+  SRJT_SLO_RELOCATE_RATE
+      rate objectives in [0, 1] (relocate = requests that failed over
+      to another replica after a device fault — a rising relocate rate
+      is the first operator signal of a flapping device)
   SRJT_SLO_WINDOW_S    rolling window (default 60 s)
   SRJT_SLO_MIN_N       minimum window population before any verdict
                        (default 8 — two requests must not page anyone)
@@ -61,6 +64,7 @@ def thresholds_from_env() -> dict:
         "deadline_rate": _env_float("SRJT_SLO_DEADLINE_RATE"),
         "defer_rate": _env_float("SRJT_SLO_DEFER_RATE"),
         "degrade_rate": _env_float("SRJT_SLO_DEGRADE_RATE"),
+        "relocate_rate": _env_float("SRJT_SLO_RELOCATE_RATE"),
     }
     return {k: v for k, v in th.items() if v is not None}
 
@@ -98,10 +102,12 @@ class SloWatchdog:
 
     def observe(self, qclass: str, e2e_ms: float, outcome: str = "ok", *,
                 degraded: bool = False, deferred: bool = False,
+                relocated: bool = False,
                 request_id: Optional[str] = None) -> list[dict]:
         """Record one resolved request and evaluate its class.  Returns
         the breaches fired (empty in the steady state).  ``outcome`` is
-        ``ok`` | ``error`` | ``deadline``."""
+        ``ok`` | ``error`` | ``deadline``; ``relocated`` marks a request
+        that failed over to another replica before resolving."""
         if not self.enabled():
             return []
         now = time.monotonic()
@@ -111,7 +117,7 @@ class SloWatchdog:
                 dq = self._obs[qclass] = collections.deque(
                     maxlen=_WINDOW_CAP)
             dq.append((now, float(e2e_ms), outcome, bool(degraded),
-                       bool(deferred)))
+                       bool(deferred), bool(relocated)))
         return self._evaluate(qclass, now, request_id=request_id)
 
     # -- evaluation ----------------------------------------------------------
@@ -149,6 +155,7 @@ class SloWatchdog:
             "deadline_rate": sum(o[2] == "deadline" for o in win) / n,
             "defer_rate": sum(o[4] for o in win) / n,
             "degrade_rate": sum(o[3] for o in win) / n,
+            "relocate_rate": sum(o[5] for o in win) / n,
         }
         verdicts = {}
         for obj, limit in self.thresholds.items():

@@ -33,6 +33,21 @@ Config schema (mirrors ``faultinj/README.md:104-141``)::
         "*": { ... }                    # wildcard, lowest precedence
       }
     }
+
+Two extensions over the reference schema serve the chaos harness
+(multi-device serving, ``exec/scheduler.py``):
+
+* ``device`` — the rule fires only when the interception happens inside a
+  matching :func:`device_scope` (the scheduler wraps each replica's
+  dispatch in its device's scope).  The analog of pinning libcufaultinj
+  to one GPU's CUDA context.  A device-mismatched named rule does NOT
+  fall through to ``"*"`` — the site is configured, just not for this
+  device.
+* ``maxHits`` (alias ``max_hits``) — an absolute cap on how many times
+  the rule fires, independent of ``interceptionCount`` (which budgets
+  *interceptions*, i.e. dice rolls).  ``maxHits: 1`` is the one-shot
+  kill used by ``ci/chaos_smoke.sh``: exactly one fatal fault, then the
+  device is genuinely healthy again for the recovery probe's canary.
 """
 
 from __future__ import annotations
@@ -58,6 +73,33 @@ class InjectedOomError(MemoryError):
 
 _INJECTION_TYPES = ("device_error", "oom", "substitute")
 
+# thread-local device scope: the scheduler marks which replica's device a
+# worker thread is currently dispatching for, so device-targeted rules can
+# discriminate (the CUDA-context analog; one process, many logical devices)
+_tls = threading.local()
+
+
+class device_scope:
+    """Mark the current thread as dispatching on device ``name`` (e.g.
+    ``"cpu:3"``); nestable context manager."""
+
+    def __init__(self, name: Optional[str]):
+        self.name = name
+        self._prev: Optional[str] = None
+
+    def __enter__(self) -> "device_scope":
+        self._prev = getattr(_tls, "device", None)
+        _tls.device = self.name
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _tls.device = self._prev
+
+
+def current_device() -> Optional[str]:
+    """The innermost :class:`device_scope` name on this thread, or None."""
+    return getattr(_tls, "device", None)
+
 
 class _Rule:
     def __init__(self, spec: dict):
@@ -67,6 +109,10 @@ class _Rule:
         if self.injection_type not in _INJECTION_TYPES:
             raise ValueError(f"unknown injectionType {self.injection_type!r}")
         self.substitute = spec.get("substituteResult")
+        self.device = spec.get("device")         # None = any device
+        mh = spec.get("maxHits", spec.get("max_hits", -1))
+        self.max_hits = int(mh) if mh is not None else -1
+        self.hits = 0
 
 
 class FaultInjector:
@@ -82,14 +128,21 @@ class FaultInjector:
         self.injected_count = 0   # observability: how many faults fired
 
     # -- config -------------------------------------------------------------
-    def load_config(self, path: str) -> None:
-        with open(path) as f:
-            cfg = json.load(f)
+    def load_dict(self, cfg: dict) -> None:
+        """Arm rules from an in-memory config dict (same schema as the
+        JSON file, minus ``dynamic``) — the chaos harness's programmatic
+        entry point for mid-run fault schedules."""
         rules = {name: _Rule(spec)
                  for name, spec in cfg.get("sites", {}).items()}
         with self._lock:
             self._rules = rules
             self._rng = random.Random(cfg.get("seed"))
+
+    def load_config(self, path: str) -> None:
+        with open(path) as f:
+            cfg = json.load(f)
+        self.load_dict(cfg)
+        with self._lock:
             self._config_path = path
             self._mtime = os.path.getmtime(path)
         if cfg.get("dynamic"):
@@ -152,16 +205,22 @@ class FaultInjector:
         returns (True, substitute_value) for a substituted result."""
         if not self._enabled:
             return None
+        dev = current_device()
         with self._lock:
             rule = self._rules.get(site) or self._rules.get("*")
             if rule is None:
                 return None
+            if rule.device is not None and rule.device != dev:
+                return None
             if rule.count == 0:
+                return None
+            if rule.max_hits >= 0 and rule.hits >= rule.max_hits:
                 return None
             if self._rng.uniform(0, 100) >= rule.percent:
                 return None
             if rule.count > 0:
                 rule.count -= 1
+            rule.hits += 1
             self.injected_count += 1
             injection_type = rule.injection_type
             substitute = rule.substitute

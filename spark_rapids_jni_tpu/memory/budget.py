@@ -261,18 +261,23 @@ def release(nbytes: int) -> None:
 
 
 @contextlib.contextmanager
-def query_budget(name: str, limit_bytes=None, **attrs):
+def query_budget(name: str, limit_bytes=None, device=None, **attrs):
     """Per-query admission scope, composed with ``metrics.query_span``.
 
     ``limit_bytes`` accepts ints or ``"512m"`` strings; None sizes from
     ``SRJT_HBM_BUDGET`` / the pair-expansion histogram
-    (:func:`default_limit`).  On exit the query span is annotated with the
-    arena peak and the query's net spill activity, so Chrome traces carry
-    the budget story next to the stage tree."""
+    (:func:`default_limit`).  ``device`` labels the scope with the replica
+    device serving the query (e.g. ``"cpu:3"``): the span is annotated and
+    a per-device peak gauge recorded, so a multi-replica scheduler's arena
+    pressure decomposes by device.  On exit the query span is annotated
+    with the arena peak and the query's net spill activity, so Chrome
+    traces carry the budget story next to the stage tree."""
     limit = parse_bytes(limit_bytes) if limit_bytes is not None \
         else default_limit()
     q = QueryBudget(name, limit)
     snap0 = metrics.snapshot()["counters"] if metrics.recording() else {}
+    if device is not None:
+        attrs = dict(attrs, device=device)
     with metrics.query_span(name, budget_bytes=limit or 0, **attrs) as sp:
         _stack().append(q)
         try:
@@ -290,3 +295,7 @@ def query_budget(name: str, limit_bytes=None, **attrs):
                         - snap0.get("arena.spill.events", 0)))
             if metrics.recording():
                 metrics.gauge_max("arena.query.peak_bytes", q.peak)
+                if device is not None:
+                    metrics.gauge_max(
+                        "arena.query.peak_bytes."
+                        + str(device).replace(":", ""), q.peak)

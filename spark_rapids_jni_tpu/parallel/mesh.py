@@ -13,17 +13,28 @@ import jax
 import numpy as np
 
 
+def local_devices(n_devices: int | None = None) -> list:
+    """The first ``n_devices`` local device handles (all when None).
+
+    The single source of device handles shared by the mesh builders here
+    and the serving layer's replica placement (``exec/placement.py``) —
+    both must agree on ordering so a replica index means the same chip
+    everywhere.  Raises when the host has fewer devices than asked."""
+    devs = list(jax.devices())
+    if n_devices is None or n_devices <= 0:
+        return devs
+    if len(devs) < n_devices:
+        raise ValueError(
+            f"need {n_devices} devices, have {len(devs)} "
+            "(tests use --xla_force_host_platform_device_count)")
+    return devs[:n_devices]
+
+
 def make_mesh(n_devices: int | None = None,
               axis_name: str = "data") -> jax.sharding.Mesh:
     """1-D mesh over the first ``n_devices`` devices (executor-pool analog)."""
-    devs = jax.devices()
-    if n_devices is not None:
-        if len(devs) < n_devices:
-            raise ValueError(
-                f"need {n_devices} devices, have {len(devs)} "
-                "(tests use --xla_force_host_platform_device_count)")
-        devs = devs[:n_devices]
-    return jax.sharding.Mesh(np.array(devs), (axis_name,))
+    return jax.sharding.Mesh(np.array(local_devices(n_devices)),
+                             (axis_name,))
 
 
 def make_2d_mesh(n_hosts: int, chips_per_host: int,

@@ -259,7 +259,10 @@ def test_quarantine_fail_fast():
     def q_fatal(tbls):
         raise InjectedDeviceError("ptx trap analog")
 
-    with xc.QueryScheduler(workers=1) as sched:
+    # recovery=False pins the legacy contract this test holds: quarantine
+    # is terminal, every later submit fails fast.  The probe-recovery
+    # lifecycle (default-on) is covered by tests/test_chaos.py.
+    with xc.QueryScheduler(workers=1, recovery=False) as sched:
         tk = sched.submit("fatal", q_fatal, tables, compiled=False)
         with pytest.raises(DeviceQuarantined):
             tk.result(timeout=60)

@@ -261,3 +261,207 @@ def test_executor_recovers_mid_query_on_cached_execution(tmp_path, shim):
     ex = ResilientExecutor(max_retries=2)
     out = ex.submit(lambda: np.asarray(stage(x)))
     np.testing.assert_array_equal(out, warm)
+
+
+# ---- chaos-harness extensions: device targeting, hit caps, watcher
+# robustness, recovery state machine ----------------------------------------
+
+from spark_rapids_jni_tpu.faultinj import injector as finj_mod
+
+
+def test_device_targeted_rule(tmp_path):
+    inj = faultinj.get_injector()
+    inj.load_dict({"sites": {"convert_to_rows": {
+        "percent": 100, "injectionType": "device_error",
+        "device": "cpu:3"}}})
+    inj.enable()
+    # outside any device scope: the rule is pinned elsewhere, no fire
+    assert len(convert_to_rows(small_table())) == 1
+    # wrong device scope: no fire either
+    with finj_mod.device_scope("cpu:1"):
+        assert len(convert_to_rows(small_table())) == 1
+    # the targeted device faults
+    with finj_mod.device_scope("cpu:3"):
+        with pytest.raises(InjectedDeviceError):
+            convert_to_rows(small_table())
+
+
+def test_device_mismatch_does_not_fall_through_to_wildcard(tmp_path):
+    # a named rule that exists but targets another device means "this
+    # site is configured, just not here" — the wildcard must not revive it
+    inj = faultinj.get_injector()
+    inj.load_dict({"sites": {
+        "convert_to_rows": {"percent": 100,
+                            "injectionType": "device_error",
+                            "device": "cpu:7"},
+        "*": {"percent": 100, "injectionType": "oom"}}})
+    inj.enable()
+    with finj_mod.device_scope("cpu:1"):
+        assert len(convert_to_rows(small_table())) == 1
+    # an UNNAMED site still falls to the wildcard on any device
+    with finj_mod.device_scope("cpu:1"):
+        with pytest.raises(InjectedOomError):
+            inj.check("some.other.site")
+
+
+def test_device_scope_nests_and_restores():
+    assert finj_mod.current_device() is None
+    with finj_mod.device_scope("cpu:0"):
+        assert finj_mod.current_device() == "cpu:0"
+        with finj_mod.device_scope("cpu:5"):
+            assert finj_mod.current_device() == "cpu:5"
+        assert finj_mod.current_device() == "cpu:0"
+    assert finj_mod.current_device() is None
+
+
+def test_max_hits_one_shot(tmp_path):
+    # maxHits caps FIRES (not interceptions): the one-shot kill used by
+    # the chaos harness — exactly one fault, then genuinely healthy
+    inj = faultinj.get_injector()
+    inj.load_dict({"sites": {"convert_to_rows": {
+        "percent": 100, "injectionType": "device_error", "maxHits": 2}}})
+    inj.enable()
+    for _ in range(2):
+        with pytest.raises(InjectedDeviceError):
+            convert_to_rows(small_table())
+    for _ in range(3):
+        assert len(convert_to_rows(small_table())) == 1
+    assert inj.injected_count == 2
+
+
+def test_watcher_survives_bad_edit(tmp_path):
+    # regression: a torn/bad config edit must not kill the watcher — the
+    # old schedule stays armed and a later good edit still reloads
+    path = write_cfg(tmp_path, {"dynamic": True, "sites": {}})
+    faultinj.enable(path)
+    inj = faultinj.get_injector()
+    assert len(convert_to_rows(small_table())) == 1
+    time.sleep(0.05)
+    with open(path, "w") as f:
+        f.write("{ this is not json")
+    os.utime(path)
+    time.sleep(0.6)                       # ≥2 poll intervals
+    assert inj._watcher is not None and inj._watcher.is_alive()
+    assert len(convert_to_rows(small_table())) == 1   # old (empty) rules
+    with open(path, "w") as f:
+        json.dump({"dynamic": True,
+                   "sites": {"convert_to_rows": {"percent": 100}}}, f)
+    os.utime(path)
+    deadline = time.time() + 5
+    fired = False
+    while time.time() < deadline:
+        try:
+            convert_to_rows(small_table())
+        except InjectedDeviceError:
+            fired = True
+            break
+        time.sleep(0.1)
+    assert fired, "watcher dead after bad edit — good edit never loaded"
+
+
+def test_watcher_stops_on_dynamic_false(tmp_path):
+    # config edited to dynamic:false → that edit loads, then the
+    # schedule freezes: later edits are ignored
+    path = write_cfg(tmp_path, {"dynamic": True, "sites": {}})
+    faultinj.enable(path)
+    inj = faultinj.get_injector()
+    time.sleep(0.05)
+    with open(path, "w") as f:
+        json.dump({"dynamic": False,
+                   "sites": {"convert_to_rows": {"percent": 100}}}, f)
+    os.utime(path)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            convert_to_rows(small_table())
+            time.sleep(0.1)
+        except InjectedDeviceError:
+            break
+    else:
+        pytest.fail("dynamic:false edit never loaded")
+    assert inj._watcher is None           # watcher shut down
+    time.sleep(0.05)
+    with open(path, "w") as f:
+        json.dump({"dynamic": True, "sites": {}}, f)    # would disarm
+    os.utime(path)
+    time.sleep(0.6)
+    with pytest.raises(InjectedDeviceError):
+        convert_to_rows(small_table())    # frozen schedule still armed
+
+
+def test_backoff_delay_bounds():
+    ex = ResilientExecutor(backoff_s=0.1, backoff_max_s=0.5, jitter=0.5,
+                           seed=1)
+    for _ in range(20):
+        assert 0.1 <= ex.backoff_delay(1) <= 0.15 + 1e-9
+        # 0.1 * 2^3 = 0.8 capped at 0.5; jitter ≤ +50%
+        assert 0.5 <= ex.backoff_delay(4) <= 0.75 + 1e-9
+    assert ResilientExecutor().backoff_delay(3) == 0.0   # backoff off
+
+
+def test_transient_retry_uses_backoff(tmp_path, shim):
+    faultinj.enable(write_cfg(tmp_path, {
+        "seed": 1,
+        "sites": {"jax.execute": {"percent": 100,
+                                  "interceptionCount": 2,
+                                  "injectionType": "oom"}}}))
+    ex = ResilientExecutor(max_retries=3, backoff_s=0.01,
+                           backoff_max_s=0.05, seed=2)
+    t0 = time.monotonic()
+    assert ex.submit(_device_work) == _device_work()
+    assert ex.retry_count == 2
+    assert time.monotonic() - t0 >= 0.02   # two backoff sleeps happened
+
+
+def test_recover_state_machine(tmp_path, shim):
+    faultinj.enable(write_cfg(tmp_path, {
+        "seed": 1,
+        "sites": {"jax.execute": {"percent": 100,
+                                  "interceptionCount": 1,
+                                  "injectionType": "device_error"}}}))
+    ex = ResilientExecutor(max_retries=1, device="cpu:2")
+    assert ex.recover() is False           # healthy: recover is a no-op
+    with pytest.raises(DeviceQuarantined):
+        ex.submit(_device_work)
+    assert ex.state == "quarantined"
+    with pytest.raises(DeviceQuarantined):
+        ex.submit(_device_work)            # fail-fast while quarantined
+    assert ex.recover() is True
+    assert ex.state == "probation"
+    assert ex.recover() is False           # already probing
+    # canary success (fault budget spent) re-admits
+    assert ex.submit(_device_work) == _device_work()
+    assert ex.state == "healthy"
+    assert ex.recovery_count == 1
+
+
+def test_probation_requarantines_on_fatal_canary(tmp_path, shim):
+    faultinj.enable(write_cfg(tmp_path, {
+        "seed": 1,
+        "sites": {"jax.execute": {"percent": 100,
+                                  "interceptionCount": 2,
+                                  "injectionType": "device_error"}}}))
+    ex = ResilientExecutor(max_retries=1)
+    with pytest.raises(DeviceQuarantined):
+        ex.submit(_device_work)
+    assert ex.recover() is True
+    with pytest.raises(DeviceQuarantined):
+        ex.submit(_device_work)            # canary hits the second fault
+    assert ex.state == "quarantined"
+    assert ex.fatal_count == 2
+
+
+def test_fail_probation_falls_back_to_quarantined(tmp_path, shim):
+    faultinj.enable(write_cfg(tmp_path, {
+        "seed": 1,
+        "sites": {"jax.execute": {"percent": 100,
+                                  "interceptionCount": 1,
+                                  "injectionType": "device_error"}}}))
+    ex = ResilientExecutor(max_retries=1)
+    with pytest.raises(DeviceQuarantined):
+        ex.submit(_device_work)
+    assert ex.recover() is True
+    ex.fail_probation()                    # canary errored non-fatally
+    assert ex.state == "quarantined"
+    with pytest.raises(DeviceQuarantined):
+        ex.submit(_device_work)

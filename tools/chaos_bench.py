@@ -1,0 +1,251 @@
+#!/usr/bin/env python
+"""Chaos bench: scripted fault schedules against the multi-device serving
+runtime → CHAOS_BENCH.json.
+
+Where ``tools/serve_bench.py`` measures the fault-free serving ceiling,
+this bench measures the ROBUSTNESS deliverables: what a device fault
+costs and what the runtime guarantees while absorbing it.  Three
+scripted scenarios over a TPC-DS mix, each asserting the chaos
+contract (zero lost requests, every response bit-identical to serial):
+
+  kill_replica — a one-shot fatal fault downs one replica mid-run.
+                 Reports the failover latency (e2e of relocated
+                 requests vs the fault-free median), the recovery time
+                 (quarantine → probe re-admission), and the
+                 post-recovery QPS ratio vs the pre-chaos baseline.
+  oom_storm    — a burst of injected allocation failures.  Transient
+                 faults retry IN PLACE with jittered backoff: the
+                 report asserts zero quarantines and counts retries.
+  flap         — repeated kill/recover rounds against the same pool.
+                 Every round must fail over and re-admit; the report
+                 carries per-round recovery times and the final pool
+                 state (all replicas healthy, none ejected).
+
+Fault schedules are armed programmatically via
+``faultinj.injector.load_dict`` (the chaos harness entry point) using
+the ``maxHits`` one-shot cap, so a "killed" device is genuinely healthy
+again when the recovery probe's canary reaches it.
+
+Usage: python tools/chaos_bench.py [n_sales] [out.json] [devices] [requests]
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+
+
+def canon(result):
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(result)]
+
+
+def identical(a, b) -> bool:
+    return len(a) == len(b) and all(
+        x.shape == y.shape and np.array_equal(x, y) for x, y in zip(a, b))
+
+
+def wait_all_healthy(sched, timeout=30.0):
+    """Block until every non-ejected replica is healthy; returns the
+    wait (the recovery time when entered right after a fault)."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        snaps = sched.ops_state()["replicas"]
+        if all(s["state"] == "healthy" for s in snaps
+               if s["state"] != "ejected"):
+            return time.monotonic() - t0
+        time.sleep(0.02)
+    raise AssertionError(
+        f"pool never recovered: {sched.ops_state()['replicas']}")
+
+
+def run_mix(sched, mix, queries, tables, oracle, timeout=600):
+    """Submit the mix, block, assert zero lost / bit-identical.
+    Returns (wall_s, tickets)."""
+    t0 = time.perf_counter()
+    tickets = [sched.submit(q, queries[q], tables) for q in mix]
+    outs = [tk.result(timeout=timeout) for tk in tickets]
+    wall = time.perf_counter() - t0
+    bad = sum(not identical(canon(out), oracle[q])
+              for out, q in zip(outs, mix))
+    assert bad == 0, f"{bad} responses diverged under chaos"
+    return wall, tickets
+
+
+def main():
+    n_sales = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
+    out_path = sys.argv[2] if len(sys.argv) > 2 else "CHAOS_BENCH.json"
+    n_devices = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+    n_requests = int(sys.argv[4]) if len(sys.argv) > 4 else 24
+
+    from benchmarks import tpcds_data
+    from spark_rapids_jni_tpu import exec as xc
+    from spark_rapids_jni_tpu.faultinj import injector as finj
+    from spark_rapids_jni_tpu.models import tpcds
+    from spark_rapids_jni_tpu.utils import flight, metrics
+
+    metrics.set_enabled(True)
+    avail = jax.local_device_count()
+    n_devices = min(n_devices, avail)
+    assert n_devices >= 2, \
+        f"chaos bench needs ≥2 devices (have {avail}; set XLA_FLAGS=" \
+        "--xla_force_host_platform_device_count=8)"
+
+    qnames = ["q3", "q42"]
+    print(f"backend: {jax.default_backend()}  devices: {n_devices}  "
+          f"n_sales: {n_sales}  mix: {qnames}  requests: {n_requests}",
+          flush=True)
+    files = tpcds_data.generate(n_sales=n_sales, n_items=2000,
+                                n_stores=12, seed=5)
+    tables = tpcds.load_tables(files)
+    mix = [qnames[i % len(qnames)] for i in range(n_requests)]
+    oracle = {q: canon(tpcds.QUERIES[q](tables)) for q in qnames}
+    inj = finj.get_injector()
+    results = {"n_sales": n_sales, "devices": n_devices,
+               "requests": n_requests, "queries": qnames}
+
+    # coalesce_ms=0: each request dispatches (and rolls the fault dice)
+    # individually — a coalesced batch is ONE interception for the whole
+    # group, which starves percent-based storm schedules.  max_retries
+    # covers the oom_storm's worst case (maxHits consecutive OOMs on one
+    # request): the storm must drain through retries, not failures.
+    sched_kw = dict(workers=n_devices, devices=n_devices,
+                    queue_depth=max(64, n_requests), coalesce_ms=0,
+                    max_retries=8, probe_base_s=0.05, probe_max_s=0.5)
+
+    def warm_variants(sched):
+        """Compile AND verify every (replica, query) plan variant out of
+        band — which replica serves a given request is wakeup order, so
+        warming through submit() cannot cover them all deterministically.
+        Two runs per variant: capture-compile, then the checked first
+        replay that validates the tape (the same double-run
+        ``tools/serve_bench.py`` uses)."""
+        for rep in sched.replicas:
+            for q in qnames:
+                with rep.scope():
+                    placed = rep.place(tables)
+                    for _ in range(2):
+                        jax.block_until_ready(sched.plans.run(
+                            q, tpcds.QUERIES[q], placed,
+                            variant=f"d{rep.index}"))
+
+    # ---- scenario 1: kill one replica mid-run ------------------------------
+    with xc.QueryScheduler(**sched_kw) as sched:
+        warm_variants(sched)    # the baseline measures serving, not compiles
+        base_wall, base_tks = run_mix(sched, mix, tpcds.QUERIES, tables,
+                                      oracle)
+        base_e2e = sorted(tk.timings["e2e_s"] for tk in base_tks)
+        base_p50 = base_e2e[len(base_e2e) // 2]
+        metrics.reset()
+        flight.reset()
+        inj.load_dict({"seed": 7, "sites": {
+            "exec.dispatch": {"percent": 100,
+                              "injectionType": "device_error",
+                              "maxHits": 1}}})
+        inj.enable()
+        chaos_wall, chaos_tks = run_mix(sched, mix, tpcds.QUERIES,
+                                        tables, oracle)
+        wait_all_healthy(sched)
+        inj.disable()
+        # recovery time from the black box: first quarantine incident →
+        # first recovery incident (wall-clock the probe lifecycle took)
+        evs = flight.events()
+        t_q = next(e["ts"] for e in evs
+                   if e["kind"] == "incident:quarantine")
+        t_r = next(e["ts"] for e in evs
+                   if e["kind"] == "incident:recovery" and e["ts"] >= t_q)
+        recovery_s = t_r - t_q
+        counters = dict(metrics.snapshot()["counters"])
+        relocated = [tk for tk in chaos_tks if tk.relocations > 0]
+        assert relocated, "fault never relocated a request"
+        assert counters.get("exec.failover.recovered", 0) >= 1, \
+            "victim never recovered"
+        reloc_e2e = sorted(tk.timings["e2e_s"] for tk in relocated)
+        # post-recovery: the healed pool serves at its pre-chaos rate
+        metrics.reset()
+        post_wall, _ = run_mix(sched, mix, tpcds.QUERIES, tables, oracle)
+    results["kill_replica"] = {
+        "baseline_qps": round(n_requests / base_wall, 2),
+        "chaos_qps": round(n_requests / chaos_wall, 2),
+        "post_recovery_qps": round(n_requests / post_wall, 2),
+        "post_recovery_ratio": round(base_wall / post_wall, 2),
+        "relocated_requests": len(relocated),
+        "failover_latency_p50_ms": round(
+            reloc_e2e[len(reloc_e2e) // 2] * 1e3, 2),
+        "baseline_e2e_p50_ms": round(base_p50 * 1e3, 2),
+        "recovery_s": round(recovery_s, 3),
+        "counters": {k: int(v) for k, v in sorted(counters.items())
+                     if k.startswith("exec.failover.")
+                     or k in ("exec.quarantined", "exec.completed")},
+        "lost_requests": 0, "responses_identical": True}
+    print(f"kill_replica: {len(relocated)} relocated, recovery "
+          f"{results['kill_replica']['recovery_s']}s, post-recovery "
+          f"{results['kill_replica']['post_recovery_ratio']}x baseline",
+          flush=True)
+
+    # ---- scenario 2: OOM storm (transient; retries, no quarantine) ---------
+    metrics.reset()
+    with xc.QueryScheduler(**sched_kw) as sched:
+        warm_variants(sched)
+        inj.load_dict({"seed": 11, "sites": {
+            "exec.dispatch": {"percent": 40, "injectionType": "oom",
+                              "maxHits": 8}}})
+        inj.enable()
+        storm_wall, _ = run_mix(sched, mix, tpcds.QUERIES, tables, oracle)
+        injected_ooms = int(inj.injected_count)   # disable() zeroes it
+        inj.disable()
+        counters = dict(metrics.snapshot()["counters"])
+        snaps = sched.ops_state()["replicas"]
+    assert all(s["state"] == "healthy" for s in snaps), snaps
+    assert counters.get("exec.quarantined", 0) == 0, \
+        "transient OOM must not quarantine"
+    assert injected_ooms >= 1, "storm never fired"
+    results["oom_storm"] = {
+        "qps": round(n_requests / storm_wall, 2),
+        "retries": int(counters.get("exec.retries", 0)),
+        "injected_ooms": injected_ooms,
+        "quarantines": 0, "lost_requests": 0,
+        "responses_identical": True}
+    print(f"oom_storm: {results['oom_storm']['retries']} retries, "
+          "0 quarantines, all identical", flush=True)
+
+    # ---- scenario 3: flapping device (kill / recover / kill again) ---------
+    metrics.reset()
+    rounds = 3
+    round_recovery = []
+    with xc.QueryScheduler(**sched_kw) as sched:
+        warm_variants(sched)
+        for r in range(rounds):
+            inj.load_dict({"seed": 100 + r, "sites": {
+                "exec.dispatch": {"percent": 100,
+                                  "injectionType": "device_error",
+                                  "maxHits": 1}}})
+            inj.enable()
+            run_mix(sched, mix, tpcds.QUERIES, tables, oracle)
+            round_recovery.append(round(wait_all_healthy(sched), 3))
+            inj.disable()
+        counters = dict(metrics.snapshot()["counters"])
+        snaps = sched.ops_state()["replicas"]
+    assert all(s["state"] == "healthy" for s in snaps), snaps
+    assert counters.get("exec.failover.recovered", 0) >= rounds, counters
+    results["flap"] = {
+        "rounds": rounds,
+        "recovery_s_per_round": round_recovery,
+        "recoveries": int(counters.get("exec.failover.recovered", 0)),
+        "ejected": int(counters.get("exec.failover.ejected", 0)),
+        "lost_requests": 0, "responses_identical": True}
+    print(f"flap: {rounds} rounds, recoveries "
+          f"{results['flap']['recoveries']}, 0 ejections, 0 lost",
+          flush=True)
+
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"wrote {out_path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -40,7 +40,16 @@ A final ``flight_overhead`` phase re-runs the 1x paced load with the
 always-on flight recorder OFF and then ON and records the steady-state
 cost (the <2% budget the recorder's always-on discipline promises).
 
+With ``--devices N`` a multi-device phase re-runs the concurrent mix
+over N replicas (``QueryScheduler(devices=N)``): requests route to
+per-device replicas with replicated inputs, and the report carries
+per-device QPS (from the ``exec.device.*.completed`` counters) plus
+failover/quarantine counts.  On hosts where the N devices are forced
+host-platform slices of one physical core, per-device QPS measures
+placement overhead honestly — not a speedup.
+
 Usage: python tools/serve_bench.py [n_sales] [out.json] [q1,q2,...] [requests]
+                                   [--devices N]
 """
 
 import json
@@ -87,11 +96,17 @@ def stage_attribution(metrics):
 
 
 def main():
-    n_sales = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
-    out_path = sys.argv[2] if len(sys.argv) > 2 else "SERVE_BENCH.json"
-    qnames = (sys.argv[3].split(",") if len(sys.argv) > 3
+    argv = list(sys.argv[1:])
+    n_devices = 1
+    if "--devices" in argv:
+        i = argv.index("--devices")
+        n_devices = int(argv[i + 1])
+        del argv[i:i + 2]
+    n_sales = int(argv[0]) if len(argv) > 0 else 200_000
+    out_path = argv[1] if len(argv) > 1 else "SERVE_BENCH.json"
+    qnames = (argv[2].split(",") if len(argv) > 2
               else ["q3", "q42", "q52", "q55"])
-    n_requests = int(sys.argv[4]) if len(sys.argv) > 4 else 32
+    n_requests = int(argv[3]) if len(argv) > 3 else 32
     import os
     workers = int(os.environ.get("SRJT_SERVE_WORKERS", "4"))
 
@@ -166,6 +181,84 @@ def main():
     print(f"concurrent:      {n_requests / conc_s:7.2f} qps "
           f"({serial_s / conc_s:.1f}x serial eager, "
           f"{sc_s / conc_s:.1f}x serial compiled)", flush=True)
+
+    # multi-device phase (--devices N): the same mix over N per-device
+    # replicas.  Per-device QPS comes from the runtime's own counters;
+    # failover counters should be zero in a fault-free run.
+    if n_devices > 1:
+        avail = jax.local_device_count()
+        n_dev = min(n_devices, avail)
+        if n_dev < n_devices:
+            print(f"multi-device: only {avail} local devices, "
+                  f"running {n_dev} replicas", flush=True)
+        metrics.reset()
+        # own plan cache: n_dev per-device variants of every query would
+        # evict the single-device entries the later phases replay warm
+        mplans = xc.PlanCache(cap=max(32, 2 * n_dev * len(qnames)))
+        with xc.QueryScheduler(workers=max(workers, n_dev), devices=n_dev,
+                               plan_cache=mplans, coalesce_ms=0,
+                               queue_depth=max(64, n_requests)) as msched:
+            # warm every (replica, query) plan variant out of band —
+            # which replica serves a submit() is wakeup order, so warming
+            # through the queue cannot cover them all deterministically.
+            # Two runs per variant: capture-compile, then the checked
+            # first replay that validates the tape.
+            for rep in msched.replicas:
+                for q in qnames:
+                    with rep.scope():
+                        placed = rep.place(tables)
+                        for _ in range(2):
+                            jax.block_until_ready(msched.plans.run(
+                                q, tpcds.QUERIES[q], placed,
+                                variant=f"d{rep.index}"))
+            # settle: the n_dev * len(qnames) compiles above leave a
+            # transient (allocator/page churn) that depresses the next
+            # few seconds of dispatch on a shared-core host — absorb it
+            # out of band so the measured run sees steady state
+            for tk in [msched.submit(q, tpcds.QUERIES[q], tables)
+                       for _, q in mix]:
+                tk.result(timeout=600)
+            metrics.reset()
+            t0 = time.perf_counter()
+            tickets = [msched.submit(q, tpcds.QUERIES[q], tables)
+                       for _, q in mix]
+            outs = [tk.result(timeout=600) for tk in tickets]
+            md_s = time.perf_counter() - t0
+            rep_names = [r.name for r in msched.replicas]
+        bad = sum(not identical(canon(out), oracle[q])
+                  for out, (_, q) in zip(outs, mix))
+        assert bad == 0, f"{bad} multi-device responses diverged"
+        snap = metrics.snapshot()["counters"]
+        per_dev = {name: int(snap.get(
+            "exec.device." + name.replace(":", "") + ".completed", 0))
+            for name in rep_names}
+        results["multi_device"] = {
+            "devices": n_dev,
+            "wall_s": round(md_s, 3),
+            "qps": round(n_requests / md_s, 2),
+            "qps_vs_single_device": round(conc_s / md_s, 2),
+            "per_device_completed": per_dev,
+            "per_device_qps": {name: round(c / md_s, 2)
+                               for name, c in per_dev.items()},
+            "devices_used": sum(1 for c in per_dev.values() if c),
+            "failover": {k: int(v) for k, v in sorted(snap.items())
+                         if k.startswith("exec.failover.")
+                         or k == "exec.quarantined"},
+            "queue_wait_ms": hist_pcts(metrics, "exec.queue_wait_ms"),
+            "e2e_ms": hist_pcts(metrics, "exec.e2e_ms"),
+            "responses_identical": True}
+        print(f"multi-device ({n_dev}): {n_requests / md_s:7.2f} qps "
+              f"({conc_s / md_s:.2f}x single-device concurrent, "
+              f"{results['multi_device']['devices_used']} devices used)",
+              flush=True)
+        # release the phase's replicated tables + variant executables and
+        # re-settle the single-device path before the paced phases below
+        del msched, mplans
+        import gc
+        gc.collect()
+        for _, q in mix:
+            jax.block_until_ready(plans.run(q, tpcds.QUERIES[q], tables))
+        metrics.reset()
 
     # batched offered-load sweep: paced open-loop arrivals at 1x/2x/4x
     # the serial-compiled ceiling.  Above 1x a serial server saturates
