@@ -71,7 +71,8 @@ def _probe_backend(max_tries: int = 3):
     try:
         return jax.devices()
     except Exception as e:  # noqa: BLE001 — any init failure handled the same
-        tries = int(os.environ.get("SRJT_BENCH_TRIES", "0"))
+        from spark_rapids_jni_tpu.utils import knobs
+        tries = knobs.get("SRJT_BENCH_TRIES")
         if tries < max_tries:
             os.environ["SRJT_BENCH_TRIES"] = str(tries + 1)
             time.sleep(5)  # short: a driver timeout must not outrun the JSON
@@ -350,8 +351,9 @@ def main():
     # by a driver-side timeout, so it is emitted the moment it exists and
     # the axes only run while budget remains (each new axis needs several
     # cold jit compiles through the remote helper)
+    from spark_rapids_jni_tpu.utils import knobs
     try:
-        budget_s = float(os.environ.get("SRJT_BENCH_BUDGET_S", "1200"))
+        budget_s = knobs.get("SRJT_BENCH_BUDGET_S")
     except ValueError:
         budget_s = 1200.0   # malformed env must not cost the headline
     t_start = time.perf_counter()

@@ -149,4 +149,13 @@ with open(os.path.join(out, "summary.json"), "w") as f:
 print("wrote", os.path.join(out, "summary.json"))
 PYEOF
 
+echo "== chaos contract under SRJT_SANITIZE=strict =="
+# Runtime sanitizers armed in strict mode: a lock-order inversion taken
+# anywhere in the failover/recovery machinery, or an unexpected plan
+# recompile, raises at the violation site and fails this smoke.
+XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=4}" \
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+SRJT_SANITIZE=strict \
+python -m pytest tests/test_chaos.py -q
+
 echo "chaos smoke OK"

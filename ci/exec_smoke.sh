@@ -193,4 +193,14 @@ with open(os.path.join(out, "summary.json"), "w") as f:
 print("wrote", os.path.join(out, "summary.json"))
 PYEOF
 
+echo "== serving contract under SRJT_SANITIZE=strict =="
+# Runtime sanitizers armed in strict mode: a lock-order inversion in the
+# scheduler/admission/coalesce path, or an unexpected recompile of a
+# warm plan (the silent jax.default_device regression class), raises at
+# the violation site and fails this smoke.
+XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+SRJT_SANITIZE=strict \
+python -m pytest tests/test_exec_runtime.py -q
+
 echo "exec smoke OK"

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Static-analysis gate — runs tools/srjt_lint.py (concurrency, retrace/
+# host-sync, knob-registry passes) against the checked-in baseline and
+# fails on any non-baselined finding.  The linter is stdlib-only (no jax
+# import) and prints a per-rule summary; budget is <30 s so it can sit at
+# the FRONT of premerge, before the native build.
+#
+# Usage: ci/lint_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== srjt_lint: static analysis vs ci/lint_baseline.json =="
+start=$(date +%s)
+python tools/srjt_lint.py --baseline ci/lint_baseline.json
+elapsed=$(( $(date +%s) - start ))
+if (( elapsed >= 30 )); then
+    echo "lint smoke FAILED: runtime ${elapsed}s exceeds the 30s budget" >&2
+    exit 1
+fi
+
+echo "lint smoke OK (${elapsed}s)"

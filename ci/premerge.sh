@@ -8,6 +8,9 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "== static analysis (srjt_lint) =="
+ci/lint_smoke.sh
+
 echo "== native build =="
 make -C spark_rapids_jni_tpu/native -s clean
 make -C spark_rapids_jni_tpu/native -s -j"$(nproc)"

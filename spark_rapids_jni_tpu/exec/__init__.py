@@ -40,6 +40,8 @@ from __future__ import annotations
 
 import os
 
+from ..utils import knobs
+
 from .admission import AdmissionController, AdmissionGrant, request_bytes
 from .errors import (ExecDeadlineExceeded, ExecError, ExecQueueFull,
                      ExecShutdown)
@@ -60,5 +62,4 @@ __all__ = [
 
 def enabled() -> bool:
     """True when the serving runtime is switched on (``SRJT_EXEC``)."""
-    return os.environ.get("SRJT_EXEC", "0").lower() \
-        not in ("0", "off", "false", "")
+    return knobs.get("SRJT_EXEC")

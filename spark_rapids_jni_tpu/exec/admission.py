@@ -39,8 +39,9 @@ import threading
 import time
 from typing import Optional
 
+from ..analysis import sanitize
 from ..memory import budget as mbudget
-from ..utils import flight, metrics
+from ..utils import flight, knobs, metrics
 from .errors import ExecDeadlineExceeded, ExecShutdown
 
 
@@ -135,10 +136,11 @@ class AdmissionController:
 
     def __init__(self, cap_bytes=None, device: Optional[str] = None):
         if cap_bytes is None:
-            cap_bytes = os.environ.get("SRJT_EXEC_INFLIGHT_BYTES")
+            cap_bytes = knobs.get("SRJT_EXEC_INFLIGHT_BYTES")
         self.cap: Optional[int] = mbudget.parse_bytes(cap_bytes)
         self.device = device
-        self._cv = threading.Condition(threading.Lock())
+        self._cv = threading.Condition(
+            sanitize.tracked_lock("exec.admission.cv"))
         self._inflight = 0
         self._closed = False
 

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import time
 
+from ..analysis import sanitize
 from ..faultinj import injector as finj
 from ..faultinj.resilience import ResilientExecutor
 from ..utils import flight, metrics
@@ -68,6 +69,34 @@ class Replica:
         self.probe_armed = False        # recovery probe owns this replica
         self.active = 0                 # in-flight requests (gauge)
         self.completed = 0              # served ok (per-device QPS)
+        # Multiple scheduler workers dispatch to the same replica (worker
+        # affinity is i → replica i mod N, and failover relocates across
+        # replicas), so the counters above are contended read-modify-
+        # writes.  Mutate them only through the note_* methods below
+        # (found by srjt_lint conc-mixed-guard; regression:
+        # tests/test_analysis.py::test_replica_counters_thread_safe).
+        self._mu = sanitize.tracked_lock(f"exec.placement.replica{index}")
+
+    # -- counters (thread-safe: shared across scheduler workers) -------------
+
+    def note_active(self, n: int = 1) -> None:
+        """In-flight delta: +n at dispatch, -n when the batch resolves."""
+        with self._mu:
+            self.active += n
+
+    def note_completed(self, n: int = 1) -> None:
+        with self._mu:
+            self.completed += n
+
+    def note_probe_failed(self) -> int:
+        """Bump and return the consecutive-failure streak."""
+        with self._mu:
+            self.fail_streak += 1
+            return self.fail_streak
+
+    def note_probe_ok(self) -> None:
+        with self._mu:
+            self.fail_streak = 0
 
     # -- state ---------------------------------------------------------------
 

@@ -53,8 +53,9 @@ import weakref
 from collections import OrderedDict
 from typing import Callable, Optional
 
+from ..analysis import sanitize
 from ..models import compiled as C
-from ..utils import metrics
+from ..utils import knobs, metrics
 
 
 class PlanCache:
@@ -65,16 +66,14 @@ class PlanCache:
     def __init__(self, cap: Optional[int] = None,
                  share_by_size: Optional[bool] = None):
         if cap is None:
-            cap = int(os.environ.get("SRJT_EXEC_PLAN_CACHE_CAP", "32"))
+            cap = knobs.get("SRJT_EXEC_PLAN_CACHE_CAP")
         if share_by_size is None:
-            share_by_size = os.environ.get(
-                "SRJT_EXEC_PLAN_SIZE_FP", "1").lower() \
-                not in ("0", "off", "false", "")
+            share_by_size = knobs.get("SRJT_EXEC_PLAN_SIZE_FP")
         self.cap = max(int(cap), 1)
         self.share_by_size = bool(share_by_size)
         # RLock: weakref death callbacks can fire at GC points on a
         # thread already inside the cache
-        self._mu = threading.RLock()
+        self._mu = sanitize.tracked_rlock("exec.plan_cache")
         self._d: "OrderedDict[tuple, dict]" = OrderedDict()
         # size key → CompiledQuery, STRONG refs by design: the sharing
         # scenario is precisely "old buffers are gone, new same-shape

@@ -35,7 +35,8 @@ import time
 from collections import OrderedDict, deque
 from typing import Callable, Optional
 
-from ..utils import flight, metrics
+from ..analysis import sanitize
+from ..utils import flight, knobs, metrics
 
 
 def _register_staged(obj) -> None:
@@ -73,9 +74,10 @@ class Prefetcher:
 
     def __init__(self, depth: Optional[int] = None):
         if depth is None:
-            depth = int(os.environ.get("SRJT_EXEC_PREFETCH_DEPTH", "2"))
+            depth = knobs.get("SRJT_EXEC_PREFETCH_DEPTH")
         self.depth = max(int(depth), 1)
-        self._cv = threading.Condition(threading.Lock())
+        self._cv = threading.Condition(
+            sanitize.tracked_lock("exec.prefetch.cv"))
         self._slots: "OrderedDict[object, dict]" = OrderedDict()
         self._todo: deque = deque()
         self._closed = False

@@ -120,11 +120,12 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from ..analysis import sanitize
 from ..faultinj import injector as finj
 from ..faultinj.resilience import DeviceQuarantined
 from ..memory import budget as mbudget
 from ..models import compiled as C
-from ..utils import flight, metrics, structured_log
+from ..utils import flight, knobs, metrics, structured_log
 from .admission import request_bytes
 from .errors import (ExecDeadlineExceeded, ExecError, ExecQueueFull,
                      ExecShutdown)
@@ -211,30 +212,28 @@ class QueryScheduler:
                  eject_after: Optional[int] = None,
                  relocate_max: Optional[int] = None):
         if workers is None:
-            workers = int(os.environ.get("SRJT_EXEC_WORKERS", "4"))
+            workers = knobs.get("SRJT_EXEC_WORKERS")
         if queue_depth is None:
-            queue_depth = int(os.environ.get("SRJT_EXEC_QUEUE_DEPTH", "32"))
+            queue_depth = knobs.get("SRJT_EXEC_QUEUE_DEPTH")
         if coalesce_ms is None:
-            coalesce_ms = float(os.environ.get("SRJT_EXEC_COALESCE_MS", "4"))
+            coalesce_ms = knobs.get("SRJT_EXEC_COALESCE_MS")
         if max_batch is None:
-            max_batch = int(os.environ.get("SRJT_EXEC_COALESCE_MAX", "16"))
+            max_batch = knobs.get("SRJT_EXEC_COALESCE_MAX")
         if devices is None:
-            devices = int(os.environ.get("SRJT_EXEC_DEVICES", "1"))
+            devices = knobs.get("SRJT_EXEC_DEVICES")
         if recovery is None:
-            recovery = os.environ.get("SRJT_EXEC_RECOVERY", "1").lower() \
-                not in ("0", "off", "false", "")
+            recovery = knobs.get("SRJT_EXEC_RECOVERY")
         if probe_base_s is None:
-            probe_base_s = float(
-                os.environ.get("SRJT_EXEC_PROBE_BASE_S", "0.05"))
+            probe_base_s = knobs.get("SRJT_EXEC_PROBE_BASE_S")
         if probe_max_s is None:
-            probe_max_s = float(
-                os.environ.get("SRJT_EXEC_PROBE_MAX_S", "2.0"))
+            probe_max_s = knobs.get("SRJT_EXEC_PROBE_MAX_S")
         if eject_after is None:
-            eject_after = int(os.environ.get("SRJT_EXEC_EJECT_AFTER", "3"))
+            eject_after = knobs.get("SRJT_EXEC_EJECT_AFTER")
         self.n_devices = max(int(devices), 1)
         if relocate_max is None:
-            relocate_max = int(os.environ.get("SRJT_EXEC_RELOCATE_MAX",
-                                              str(self.n_devices)))
+            relocate_max = knobs.get("SRJT_EXEC_RELOCATE_MAX")
+            if relocate_max is None:
+                relocate_max = self.n_devices
         # every device needs at least one affine worker to serve at all
         self.workers = max(int(workers), 1, self.n_devices)
         self.queue_depth = max(int(queue_depth), 1)
@@ -245,10 +244,8 @@ class QueryScheduler:
         self.probe_max_s = max(float(probe_max_s), self.probe_base_s)
         self.eject_after = max(int(eject_after), 1)
         self.relocate_max = max(int(relocate_max), 1)
-        self.default_timeout_s: Optional[float] = None
-        dl = os.environ.get("SRJT_EXEC_DEADLINE")
-        if dl:
-            self.default_timeout_s = float(dl)
+        self.default_timeout_s: Optional[float] = \
+            knobs.get("SRJT_EXEC_DEADLINE")
         self.replicas: list[Replica] = build_replicas(
             self.n_devices, inflight_bytes=inflight_bytes,
             max_retries=max_retries)
@@ -260,7 +257,8 @@ class QueryScheduler:
         self.prefetcher = Prefetcher() if prefetch else None
         self.slo = SloWatchdog()
         self._heap: list[tuple[int, int, _Request]] = []
-        self._cv = threading.Condition(threading.Lock())
+        self._cv = threading.Condition(
+            sanitize.tracked_lock("exec.scheduler.cv"))
         self._seq = itertools.count()
         self._closed = False
         self._probe_rng = random.Random(0x5e1f)
@@ -588,12 +586,12 @@ class QueryScheduler:
             # still faulting (or the canary miscompared — treat a wrong
             # answer exactly like a fault: the device cannot be trusted)
             rep.resilient.fail_probation()
-            rep.fail_streak += 1
+            streak = rep.note_probe_failed()
             if metrics.recording():
                 metrics.count("exec.failover.probe_failed")
             flight.record("exec.failover.probe_failed", device=rep.name,
-                          streak=rep.fail_streak, error=type(e).__name__)
-            if rep.fail_streak >= self.eject_after:
+                          streak=streak, error=type(e).__name__)
+            if streak >= self.eject_after:
                 rep.eject()
                 with self._cv:
                     rep.probe_armed = False
@@ -604,7 +602,7 @@ class QueryScheduler:
                     rep.schedule_probe(self.probe_base_s, self.probe_max_s,
                                        self._probe_rng)
             return
-        rep.fail_streak = 0
+        rep.note_probe_ok()
         with self._cv:
             rep.probe_armed = False
             self._cv.notify_all()       # unpark this replica's workers
@@ -828,7 +826,7 @@ class QueryScheduler:
         t0 = time.monotonic()
         retries0 = rep.resilient.retry_count
         variant = self._variant(rep, False)
-        rep.active += len(batch)
+        rep.note_active(len(batch))
         try:
             with grant, structured_log.bound(batch_rids=",".join(rids)):
                 scope = mbudget.query_budget(
@@ -866,7 +864,7 @@ class QueryScheduler:
                 retried = rep.resilient.retry_count - retries0
                 if retried:
                     metrics.count("exec.retries", retried)
-            rep.completed += len(batch)
+            rep.note_completed(len(batch))
             for r, out in zip(batch, outs):
                 r.ticket.timings["exec_s"] = dt
                 r.ticket.timings["e2e_s"] = t_done - r.t_submit
@@ -900,7 +898,7 @@ class QueryScheduler:
                                    incident_kind="request_failed",
                                    batch=rids)
         finally:
-            rep.active -= len(batch)
+            rep.note_active(-len(batch))
 
     def _serve(self, req: _Request, rep: Replica) -> None:
         tk = req.ticket
@@ -960,7 +958,7 @@ class QueryScheduler:
         t0 = time.monotonic()
         retries0 = rep.resilient.retry_count
         variant = self._variant(rep, grant.degrade)
-        rep.active += 1
+        rep.note_active()
         try:
             with grant, structured_log.bound(request_id=req.rid):
                 # degraded admission: the dense engine's O(key-range)
@@ -1028,7 +1026,7 @@ class QueryScheduler:
                 retried = rep.resilient.retry_count - retries0
                 if retried:
                     metrics.count("exec.retries", retried)
-            rep.completed += 1
+            rep.note_completed()
             self._resolve_ok(req, result, degraded=grant.degrade,
                              deferred=grant.deferred,
                              relocated=req.relocations > 0)
@@ -1047,4 +1045,4 @@ class QueryScheduler:
                                incident_kind="request_failed",
                                batch=tk.batch_rids)
         finally:
-            rep.active -= 1
+            rep.note_active(-1)

@@ -36,35 +36,28 @@ from __future__ import annotations
 
 import collections
 import os
-import threading
 import time
 from typing import Optional
 
-from ..utils import flight, metrics, structured_log
+from ..analysis import sanitize
+from ..utils import flight, knobs, metrics, structured_log
 
 _WINDOW_CAP = 4096          # per-class sample bound, whatever the window
 
 _RATE_OUTCOMES = ("error", "deadline", "defer", "degrade")
 
 
-def _env_float(name: str) -> Optional[float]:
-    v = os.environ.get(name)
-    if v is None or not v.strip():
-        return None
-    return float(v)
-
-
 def thresholds_from_env() -> dict:
     """The configured objectives; empty dict when none are set."""
     th = {
-        "p50_ms": _env_float("SRJT_SLO_P50_MS"),
-        "p95_ms": _env_float("SRJT_SLO_P95_MS"),
-        "p99_ms": _env_float("SRJT_SLO_P99_MS"),
-        "error_rate": _env_float("SRJT_SLO_ERROR_RATE"),
-        "deadline_rate": _env_float("SRJT_SLO_DEADLINE_RATE"),
-        "defer_rate": _env_float("SRJT_SLO_DEFER_RATE"),
-        "degrade_rate": _env_float("SRJT_SLO_DEGRADE_RATE"),
-        "relocate_rate": _env_float("SRJT_SLO_RELOCATE_RATE"),
+        "p50_ms": knobs.get("SRJT_SLO_P50_MS"),
+        "p95_ms": knobs.get("SRJT_SLO_P95_MS"),
+        "p99_ms": knobs.get("SRJT_SLO_P99_MS"),
+        "error_rate": knobs.get("SRJT_SLO_ERROR_RATE"),
+        "deadline_rate": knobs.get("SRJT_SLO_DEADLINE_RATE"),
+        "defer_rate": knobs.get("SRJT_SLO_DEFER_RATE"),
+        "degrade_rate": knobs.get("SRJT_SLO_DEGRADE_RATE"),
+        "relocate_rate": knobs.get("SRJT_SLO_RELOCATE_RATE"),
     }
     return {k: v for k, v in th.items() if v is not None}
 
@@ -79,16 +72,16 @@ class SloWatchdog:
         if thresholds is None:
             thresholds = thresholds_from_env()
         if window_s is None:
-            window_s = float(os.environ.get("SRJT_SLO_WINDOW_S", "60"))
+            window_s = knobs.get("SRJT_SLO_WINDOW_S")
         if min_n is None:
-            min_n = int(os.environ.get("SRJT_SLO_MIN_N", "8"))
+            min_n = knobs.get("SRJT_SLO_MIN_N")
         if cooldown_s is None:
-            cooldown_s = float(os.environ.get("SRJT_SLO_COOLDOWN_S", "30"))
+            cooldown_s = knobs.get("SRJT_SLO_COOLDOWN_S")
         self.thresholds = dict(thresholds)
         self.window_s = max(float(window_s), 1e-3)
         self.min_n = max(int(min_n), 1)
         self.cooldown_s = max(float(cooldown_s), 0.0)
-        self._mu = threading.Lock()
+        self._mu = sanitize.tracked_lock("exec.slo")
         # class -> deque of (ts, e2e_ms, outcome, degraded, deferred)
         self._obs: dict[str, collections.deque] = {}
         self._last_alarm: dict[tuple, float] = {}

@@ -60,6 +60,8 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from ..analysis import sanitize
+
 ENV_CONFIG_PATH = "FAULT_INJECTOR_CONFIG_PATH"   # same env var as faultinj.cu:93
 
 
@@ -117,7 +119,7 @@ class _Rule:
 
 class FaultInjector:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = sanitize.tracked_lock("faultinj.injector")
         self._rules: dict[str, _Rule] = {}
         self._rng = random.Random()
         self._enabled = False

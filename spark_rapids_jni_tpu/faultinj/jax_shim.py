@@ -37,11 +37,11 @@ Usage::
 from __future__ import annotations
 
 import functools
-import threading
 
+from ..analysis import sanitize
 from .injector import get_injector
 
-_LOCK = threading.Lock()
+_LOCK = sanitize.tracked_lock("faultinj.jax_shim")
 _PATCHED: dict[str, tuple] = {}
 
 

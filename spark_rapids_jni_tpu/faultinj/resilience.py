@@ -32,10 +32,10 @@ herd fix; Spark's task-retry backoff does the same).
 from __future__ import annotations
 
 import random
-import threading
 import time
 from typing import Any, Callable, Optional
 
+from ..analysis import sanitize
 from ..utils import flight
 from .injector import InjectedDeviceError, InjectedOomError
 
@@ -69,7 +69,7 @@ class ResilientExecutor:
         self.backoff_max_s = backoff_max_s
         self.jitter = max(float(jitter), 0.0)
         self.device = device
-        self._mu = threading.Lock()
+        self._mu = sanitize.tracked_lock("faultinj.resilience")
         self.state = "healthy"          # healthy | quarantined | probation
         self.retry_count = 0            # observability
         self.fatal_count = 0

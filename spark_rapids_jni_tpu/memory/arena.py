@@ -36,15 +36,15 @@ from __future__ import annotations
 
 import contextlib
 import os
-import threading
 from collections import OrderedDict
 
-from ..utils import metrics
+from ..analysis import sanitize
+from ..utils import knobs, metrics
 from . import budget
 
 MIN_CLASS = 256
 
-_lock = threading.RLock()
+_lock = sanitize.tracked_rlock("memory.arena")
 _free: dict[tuple, list] = {}            # (class, device) → [u8 arrays]
 _zeros: "OrderedDict[tuple, object]" = OrderedDict()
 _zeros_bytes = 0
@@ -65,8 +65,7 @@ def size_class(nbytes: int) -> int:
 
 
 def _zeros_cap() -> int:
-    return budget.parse_bytes(
-        os.environ.get("SRJT_ARENA_ZEROS_CAP", "16m")) or 0
+    return budget.parse_bytes(knobs.get("SRJT_ARENA_ZEROS_CAP")) or 0
 
 
 def _device_id(arr) -> int:

@@ -46,7 +46,8 @@ import os
 import threading
 from typing import Optional
 
-from ..utils import flight, metrics
+from ..analysis import sanitize
+from ..utils import flight, knobs, metrics
 
 # pair-expansion working set per output pair in ops/join.py: pair_ids,
 # left_idx, within, r_pos, right_idx int64 lanes + the matched mask
@@ -54,13 +55,11 @@ PAIR_EXPANSION_BYTES = 40
 _HEADROOM = 4.0
 _FLOOR_BYTES = 64 << 20
 
-_LOCK = threading.RLock()      # shared with memory.spill (lock order:
+_LOCK = sanitize.tracked_rlock("memory.budget")      # shared with memory.spill (lock order:
 #                                budget → spill registry, never reversed)
 
-_enabled: bool = (
-    os.environ.get("SRJT_HBM_ARENA", "0").lower()
-    not in ("0", "off", "false", "")
-    or bool(os.environ.get("SRJT_HBM_BUDGET")))
+_enabled: bool = (knobs.get("SRJT_HBM_ARENA")
+                  or bool(knobs.get("SRJT_HBM_BUDGET")))
 
 
 class HbmBudgetExceeded(RuntimeError):
@@ -88,9 +87,8 @@ def set_enabled(on: Optional[bool] = None) -> None:
     """Toggle the arena subsystem; ``None`` re-reads the env knobs."""
     global _enabled
     if on is None:
-        _enabled = (os.environ.get("SRJT_HBM_ARENA", "0").lower()
-                    not in ("0", "off", "false", "")
-                    or bool(os.environ.get("SRJT_HBM_BUDGET")))
+        _enabled = (knobs.get("SRJT_HBM_ARENA")
+                    or bool(knobs.get("SRJT_HBM_BUDGET")))
     else:
         _enabled = bool(on)
 
@@ -159,7 +157,7 @@ def current() -> Optional[QueryBudget]:
 
 
 def process_limit() -> Optional[int]:
-    return parse_bytes(os.environ.get("SRJT_HBM_BUDGET"))
+    return parse_bytes(knobs.get("SRJT_HBM_BUDGET"))
 
 
 def limit_now() -> Optional[int]:

@@ -21,13 +21,13 @@ fault back (or rebuild) on their next touch.
 
 from __future__ import annotations
 
-import threading
 import weakref
 from collections import OrderedDict
 from typing import Callable, Optional
 
 import numpy as np
 
+from ..analysis import sanitize
 from ..utils import flight, metrics
 from . import budget
 
@@ -135,7 +135,7 @@ class SpillableArrays:
         self._host: Optional[dict] = None
         self.nbytes = sum(int(getattr(a, "nbytes", 0) or 0)
                           for a in arrays.values() if a is not None)
-        self._mu = threading.RLock()
+        self._mu = sanitize.tracked_rlock("memory.spill")
 
     @property
     def spilled(self) -> bool:

@@ -38,14 +38,19 @@ memo is disabled under capture/replay so tapes stay aligned.)
 
 from __future__ import annotations
 
-import threading
+import itertools
 from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import sanitize
 from ..utils import flight, metrics, syncs
+
+# Retrace-tripwire identity: a process-wide serial, not id(self) — ids
+# recycle, and a dead plan's warmup must not mask a live plan's retrace.
+_plan_serial = itertools.count()
 
 
 class StaleTapeError(ValueError):
@@ -83,11 +88,13 @@ class CompiledQuery:
                 self.expected = _materialized(qfn(tables))
         self.tape = tuple(tape)
         metrics.observe("compiled.tape_len", len(self.tape))
+        self._trace_key = f"{qname}#{next(_plan_serial)}"
 
         def _traced(tbls):
             # counted at trace time on purpose: each execution of this
             # body IS one (re)trace → XLA recompile of the query program
             metrics.count("compiled.recompile", in_trace=True)
+            sanitize.note_trace(self._trace_key)
             with syncs.replay(list(self.tape)):
                 return _materialized(qfn(tbls))
         _traced.__name__ = f"compiled_{qname}"
@@ -98,7 +105,7 @@ class CompiledQuery:
         # batch (exec/plan_cache.py run_batched): None = not yet probed,
         # True = parity-verified, False = rejected (trace failure or a
         # parity mismatch) — once False the plan never batches again
-        self._vlock = threading.Lock()
+        self._vlock = sanitize.tracked_lock("models.compiled.vmap")
         self._vprog = None
         self._vtreedef = None
         self._batchable: Optional[bool] = None
@@ -205,7 +212,10 @@ class CompiledQuery:
         try:
             with metrics.span(f"compiled.batch:{self.name}",
                               size=len(tables_list)):
-                out = self._vprog(stacked)
+                # a vmap build (or a new batch size) re-traces the tape
+                # body on purpose — not the silent-recompile bug class
+                with sanitize.allow_retrace():
+                    out = self._vprog(stacked)
             metrics.count("compiled.batch_replay")
         except Exception:
             metrics.count("compiled.batch_unsupported")

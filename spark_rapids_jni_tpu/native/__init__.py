@@ -12,12 +12,13 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import Optional
+
+from ..analysis import sanitize
 
 _NATIVE_DIR = os.path.dirname(__file__)
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libsrjt.so")
-_lock = threading.Lock()
+_lock = sanitize.tracked_lock("native.load")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 

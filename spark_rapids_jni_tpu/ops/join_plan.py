@@ -61,8 +61,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
+from ..analysis import sanitize
 from ..column import Column, Table, as_dict_column, force_column
-from ..utils import metrics, syncs
+from ..utils import knobs, metrics, syncs
 from .filter import sized_nonzero
 
 DENSE_SPAN_FACTOR = 2
@@ -78,7 +79,7 @@ _forced_tls = threading.local()    # .kind: None | "dense" | "sorted"
 
 def forced_engine() -> Optional[str]:
     f = getattr(_forced_tls, "kind", None) \
-        or os.environ.get("SRJT_JOIN_ENGINE")
+        or knobs.get("SRJT_JOIN_ENGINE")
     return f if f in ("dense", "sorted") else None
 
 
@@ -147,8 +148,7 @@ class _IndexCache:
     @staticmethod
     def _cap() -> Optional[int]:
         from ..memory import budget as mbudget
-        return mbudget.parse_bytes(
-            os.environ.get("SRJT_INDEX_CACHE_CAP", "512m"))
+        return mbudget.parse_bytes(knobs.get("SRJT_INDEX_CACHE_CAP"))
 
     def _drop(self, key, *, count_eviction: bool) -> None:
         from ..memory import spill as mspill
@@ -480,7 +480,7 @@ class _PlanCache:
     def __init__(self, cap: int = 8):
         self._d: "OrderedDict[tuple, dict]" = OrderedDict()
         self._cap = cap
-        self._mu = threading.RLock()
+        self._mu = sanitize.tracked_rlock("ops.join_plan.index_cache")
 
     def _evict(self, key) -> None:
         with self._mu:

@@ -39,7 +39,7 @@ import numpy as np
 
 from .. import types as T
 from ..column import Column, DictColumn, Table
-from ..utils import metrics
+from ..utils import knobs, metrics
 from ..utils.tracing import traced
 from . import decode as D
 from .footer import extract_footer_bytes
@@ -602,9 +602,7 @@ def _dict_strings_enabled() -> bool:
     """SRJT_DICT_STRINGS: keep dictionary-encoded string columns as
     :class:`DictColumn` codes (default on; 0/off reverts to eager
     materialization for differential testing)."""
-    import os
-    return os.environ.get("SRJT_DICT_STRINGS", "1").lower() not in (
-        "0", "off")
+    return knobs.get("SRJT_DICT_STRINGS")
 
 
 def _scan_dict_str(parts, jvalid, n_total: int):
@@ -1062,7 +1060,7 @@ def scan_table(file_bytes: bytes,
         for i in want:
             chunk_lists[i].append(chunks[i])
 
-    fused = os.environ.get("SRJT_FUSED_SCAN", "1").lower()         not in ("0", "off")
+    fused = knobs.get("SRJT_FUSED_SCAN")
     fallback: list[int] = []
     by_index: dict[int, Column] = {}
     deferred: list[tuple] = []          # (col index, key, statics, args,

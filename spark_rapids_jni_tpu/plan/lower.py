@@ -106,7 +106,9 @@ def _rowgroup_literal(v):
     bytes and prune BYTE_ARRAY chunks (parquet's UTF8 logical order IS
     unsigned byte order, so Python bytes comparison matches)."""
     if hasattr(v, "item"):
-        v = v.item()
+        # planning-time literal from the query spec (numpy scalar), never
+        # a traced value — rowgroup pruning runs before any jit
+        v = v.item()  # srjt-lint: disable=trace-host-sync
     if isinstance(v, bool):
         return None
     if isinstance(v, int):

@@ -37,7 +37,7 @@ import os
 from dataclasses import dataclass, field, replace
 from typing import Optional, Sequence
 
-from ..utils import metrics
+from ..utils import knobs, metrics
 from . import ir
 
 
@@ -319,15 +319,15 @@ def optimize(tree: ir.Plan, schemas: dict, stats=None,
     ``schemas`` maps base-table name → column names; ``stats`` is an
     optional :class:`plan.stats.CardinalityStats` for join reordering.
     """
-    if os.environ.get("SRJT_PLAN_OPT", "1") == "0":
+    if not knobs.get("SRJT_PLAN_OPT"):
         return OptimizeResult(tree, (), (), 0, True)
     active = list(DEFAULT_RULES if rules is None else rules)
-    only = os.environ.get("SRJT_PLAN_RULES")
+    only = knobs.get("SRJT_PLAN_RULES")
     if only:
         wanted = {r.strip() for r in only.split(",") if r.strip()}
         active = [r for r in active if r.name in wanted]
     if max_passes is None:
-        max_passes = int(os.environ.get("SRJT_PLAN_MAX_PASSES", "10"))
+        max_passes = knobs.get("SRJT_PLAN_MAX_PASSES")
 
     ir.schema_of(tree, schemas)      # validate before rewriting
     ctx = Context(schemas=schemas, stats=stats)

@@ -18,11 +18,11 @@ the reorder rule rejects (a deliberate no-op: never reorder blind).
 from __future__ import annotations
 
 import os
-import threading
 from collections import OrderedDict
 from typing import Optional
 
-from ..utils import metrics
+from ..analysis import sanitize
+from ..utils import knobs, metrics
 from . import ir
 
 _MAX_ENTRIES = 4096
@@ -30,8 +30,7 @@ _MAX_ENTRIES = 4096
 
 def _default_cap() -> int:
     try:
-        return max(int(os.environ.get("SRJT_PLAN_STATS_CAP",
-                                      _MAX_ENTRIES)), 1)
+        return max(knobs.get("SRJT_PLAN_STATS_CAP"), 1)
     except ValueError:
         return _MAX_ENTRIES
 
@@ -46,7 +45,7 @@ class CardinalityStats:
     the ``plan.stats.evictions`` counter."""
 
     def __init__(self, max_entries: Optional[int] = None):
-        self._lock = threading.Lock()
+        self._lock = sanitize.tracked_lock("plan.stats")
         self._rows: OrderedDict[str, int] = OrderedDict()
         self._max = _default_cap() if max_entries is None else max(
             int(max_entries), 1)

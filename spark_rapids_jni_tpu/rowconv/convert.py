@@ -43,7 +43,7 @@ import numpy as np
 from .. import types as T
 from ..column import Column, DictColumn, Table, as_dict_column, force_column
 from ..faultinj import fault_site
-from ..utils import bitmask, metrics
+from ..utils import bitmask, knobs, metrics, syncs
 from ..utils.tracing import traced
 from .layout import (RowLayout, compute_row_layout, build_batches,
                      row_sizes_with_strings, MAX_ROW_SIZE, MAX_BATCH_BYTES,
@@ -493,7 +493,7 @@ def _fixed_engine(direction: str) -> str:
     everywhere (contiguous [n, W] slices: 64.1 GB/s at 12 cols, 825 GB/s
     at 212 vs perm's 26.5/192.7).  SRJT_FIXED_CONCAT overrides both
     directions for A/B; read OUTSIDE jit and passed as a static arg."""
-    env = os.environ.get("SRJT_FIXED_CONCAT")
+    env = knobs.get("SRJT_FIXED_CONCAT")
     if env is not None:
         return "concat" if env.lower() in ("1", "on") else "perm"
     return "perm" if direction == "to" else "concat"
@@ -946,7 +946,7 @@ def convert_to_rows(table: Table,
     batches = build_batches(row_sizes, max_batch_bytes)
     from . import ragged, xpack
     use_dma = ragged.dma_supported()
-    use_xpack = os.environ.get("SRJT_XPACK", "1").lower() not in ("0", "off")
+    use_xpack = knobs.get("SRJT_XPACK")
     out = []
     for bi, (lo, hi) in enumerate(zip(batches.row_boundaries[:-1],
                                       batches.row_boundaries[1:])):
@@ -1073,7 +1073,7 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
 
     from . import ragged, xpack
     from ..utils import hostcache
-    if os.environ.get("SRJT_XPACK", "1").lower() not in ("0", "off"):
+    if knobs.get("SRJT_XPACK"):
         # primary engine (round 5): the inverse xpack — one fused program
         # for the whole batch, one memoized stacked sync for the geometry
         # (copy_strings_from_rows + chars-scan analog,
@@ -1108,8 +1108,10 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
         out_offsets = []
         chars = []
         if n <= _DMA_FROM_ROWS_MAX_N:
-            # ONE host sync for all columns' slots
-            slots_np = (np.asarray(jnp.stack(slots), dtype=np.int64)
+            # ONE host sync for all columns' slots, counted in the
+            # syncs-per-query funnel (eager path, never traced)
+            syncs.note_sync()
+            slots_np = (np.asarray(jnp.stack(slots), dtype=np.int64)  # srjt-lint: disable=trace-host-sync
                         if slots else np.zeros((0, n, 2), np.int64))
             for vi in range(nvar):
                 s = slots_np[vi]
@@ -1147,7 +1149,8 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
                           for s in slots]
             # one stacked tiny sync: totals + violation counts + the
             # segmented-gather geometry stats (device-computed maxima)
-            meta = np.asarray(jnp.stack(
+            syncs.note_sync()
+            meta = np.asarray(jnp.stack(  # srjt-lint: disable=trace-host-sync
                 [jnp.concatenate([
                     jnp.stack([o[-1], v.astype(jnp.int64)]),
                     xpack._seg_gather_stats(st, s[:, 1], o)])
@@ -1180,7 +1183,9 @@ def convert_from_rows(batch: RowBatch, schema: Sequence[T.DType]) -> Table:
         jnp.concatenate([jnp.zeros((1,), jnp.int64),
                          jnp.cumsum(s[:, 1].astype(jnp.int64))])
         for s in slots]
-    totals_np = (np.asarray(jnp.stack([o[-1] for o in out_offsets]))
+    # the documented per-column char-total pull: one stacked sync, counted
+    syncs.note_sync()
+    totals_np = (np.asarray(jnp.stack([o[-1] for o in out_offsets]))  # srjt-lint: disable=trace-host-sync
                  if out_offsets else np.zeros((0,), np.int64))
     char_totals = [int(t) for t in totals_np]
     datas, valid, chars = _from_rows_var(

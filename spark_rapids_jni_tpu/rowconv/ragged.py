@@ -43,6 +43,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import knobs
+
 LANE = 128
 _WINDOW_ALIGN = 512          # bytes; Mosaic DMA minor-dim tile for u32
 
@@ -51,7 +53,7 @@ def dma_supported() -> bool:
     """The Pallas DMA path runs on real TPU backends only (interpret mode
     does not model the DMA/semaphore pipeline faithfully enough to be worth
     maintaining); elsewhere the XLA fallback is used."""
-    if os.environ.get("SRJT_RAGGED_DMA", "auto").lower() in ("0", "off"):
+    if not knobs.get("SRJT_RAGGED_DMA"):
         return False
     return jax.default_backend() == "tpu"
 

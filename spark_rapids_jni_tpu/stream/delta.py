@@ -18,9 +18,9 @@ statistically-disjoint groups before any page decode.
 
 from __future__ import annotations
 
-import threading
 from typing import Optional, Sequence
 
+from ..analysis import sanitize
 from ..column import Table
 from ..parquet import decode as D
 from ..parquet import device_scan
@@ -57,7 +57,7 @@ class DeltaTable:
     def __init__(self, name: str = "fact",
                  files: Optional[Sequence[bytes]] = None):
         self.name = name
-        self._lock = threading.RLock()
+        self._lock = sanitize.tracked_rlock("stream.delta")
         self._files: list[bytes] = []
         self._rg_rows: list[tuple[int, ...]] = []
         self._rg_bytes: list[tuple[int, ...]] = []

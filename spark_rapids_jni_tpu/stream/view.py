@@ -34,16 +34,16 @@ its next refresh.
 from __future__ import annotations
 
 import os
-import threading
 from typing import Optional
 
+from ..analysis import sanitize
 from ..column import Table
 from ..memory import spill as mspill
 from ..ops import apply_boolean_mask, slice_table, sort_table
 from ..ops import groupby as G
 from ..plan import ir, lower, rules
 from ..plan import stats as plan_stats
-from ..utils import flight, metrics
+from ..utils import flight, knobs, metrics
 from .delta import DeltaTable, Watermark
 
 _PRE_NODES = (ir.Scan, ir.Filter, ir.Project, ir.Join)
@@ -51,8 +51,7 @@ _POST_NODES = (ir.Sort, ir.Limit, ir.Filter)
 
 
 def _allow_approx_default() -> bool:
-    return os.environ.get("SRJT_STREAM_ALLOW_APPROX", "0").lower() \
-        in ("1", "true", "on")
+    return knobs.get("SRJT_STREAM_ALLOW_APPROX")
 
 
 class MaterializedView:
@@ -81,7 +80,7 @@ class MaterializedView:
         self.state: Optional[Table] = None
         self.watermark: Optional[Watermark] = None
         self.epoch = 0
-        self.lock = threading.Lock()
+        self.lock = sanitize.tracked_lock("stream.view")
         self.refreshes = 0
         self.exact = False
 
@@ -100,7 +99,7 @@ class ViewRegistry:
         self.schemas[delta.name] = delta.schema()
         self.allow_approx = (_allow_approx_default() if allow_approx is None
                              else bool(allow_approx))
-        self._mu = threading.Lock()
+        self._mu = sanitize.tracked_lock("stream.view_registry")
         self._by_fp: dict[str, MaterializedView] = {}
         self._by_name: dict[str, MaterializedView] = {}
         self._fallbacks = 0

@@ -54,14 +54,14 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
-from . import metrics, structured_log
+from ..analysis import sanitize
+from . import knobs, metrics, structured_log
 
-_enabled: bool = os.environ.get(
-    "SRJT_FLIGHT", "1").lower() not in ("0", "off", "false", "")
+_enabled: bool = knobs.get("SRJT_FLIGHT")
 
-_lock = threading.Lock()
+_lock = sanitize.tracked_lock("utils.flight")
 _ring: "collections.deque[dict]" = collections.deque(
-    maxlen=max(int(os.environ.get("SRJT_FLIGHT_N", "512")), 8))
+    maxlen=max(knobs.get("SRJT_FLIGHT_N"), 8))
 _probes: dict[str, Callable[[], Any]] = {}
 _incident_counts: dict[str, int] = {}
 _incident_seq = 0
@@ -75,8 +75,7 @@ def set_enabled(on: Optional[bool] = None) -> None:
     """Toggle the recorder at runtime; ``None`` re-reads the env knob."""
     global _enabled
     if on is None:
-        _enabled = os.environ.get(
-            "SRJT_FLIGHT", "1").lower() not in ("0", "off", "false", "")
+        _enabled = knobs.get("SRJT_FLIGHT")
     else:
         _enabled = bool(on)
 
@@ -156,7 +155,7 @@ def sample_probes() -> dict:
 
 
 def incident_dir() -> Optional[str]:
-    return os.environ.get("SRJT_INCIDENT_DIR") or None
+    return knobs.get("SRJT_INCIDENT_DIR")
 
 
 def incident(kind: str, *, request_id: Optional[str] = None,
@@ -177,7 +176,7 @@ def incident(kind: str, *, request_id: Optional[str] = None,
         out_dir = incident_dir()
         if not _enabled or out_dir is None:
             return None
-        cap = max(int(os.environ.get("SRJT_INCIDENT_PER_KIND", "5")), 1)
+        cap = max(knobs.get("SRJT_INCIDENT_PER_KIND"), 1)
         with _lock:
             n = _incident_counts.get(kind, 0)
             if n >= cap:

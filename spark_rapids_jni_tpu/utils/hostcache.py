@@ -20,12 +20,13 @@ longer grow host RSS without bound.
 from __future__ import annotations
 
 import os
-import threading
 import weakref
 from collections import OrderedDict
 from typing import Any, Callable, Optional
 
 import numpy as np
+
+from ..analysis import sanitize
 
 
 class WeakIdMemo:
@@ -56,7 +57,7 @@ class WeakIdMemo:
         self._bytes = 0
         self._cap = cap_bytes
         self._on_evict = on_evict
-        self._mu = threading.RLock()
+        self._mu = sanitize.tracked_rlock("utils.hostcache.memo")
 
     def _cap_now(self) -> Optional[int]:
         c = self._cap
@@ -113,7 +114,8 @@ class WeakIdMemo:
 
 def _host_cap() -> Optional[int]:
     from ..memory.budget import parse_bytes
-    return parse_bytes(os.environ.get("SRJT_HOSTCACHE_CAP", "256m"))
+    from . import knobs
+    return parse_bytes(knobs.get("SRJT_HOSTCACHE_CAP"))
 
 
 def _count_host_eviction() -> None:

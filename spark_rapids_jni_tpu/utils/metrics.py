@@ -42,19 +42,22 @@ import os
 import re
 import threading
 import time
+
+from ..analysis import sanitize
+from . import knobs
 from typing import Any, Optional
 
 _enabled: bool = os.environ.get(
     "SPARK_RAPIDS_TPU_METRICS", "0").lower() not in ("0", "off", "false", "")
 
-_lock = threading.Lock()
+_lock = sanitize.tracked_lock("utils.metrics")
 _counters: dict[str, float] = {}
 _gauges: dict[str, float] = {}
 _hists: dict[str, dict] = {}        # name -> {count,total,min,max,buckets}
 # bounded (ts, value) sample tails per histogram, feeding the
 # rolling-window percentile path (the SLO watchdog's quantiles); the
 # log2 buckets above stay the process-lifetime story
-_WINDOW_N = max(int(os.environ.get("SRJT_METRICS_WINDOW_N", "1024")), 16)
+_WINDOW_N = max(knobs.get("SRJT_METRICS_WINDOW_N"), 16)
 _samples: dict[str, "collections.deque[tuple[float, float]]"] = {}
 
 _EPOCH = time.perf_counter()        # trace time base (ts exported rel. us)
@@ -511,7 +514,7 @@ def to_prometheus() -> str:
 
 
 _http_server = None
-_http_lock = threading.Lock()
+_http_lock = sanitize.tracked_lock("utils.metrics.http")
 
 
 def start_http_server(port: Optional[int] = None):
@@ -522,7 +525,7 @@ def start_http_server(port: Optional[int] = None):
     when no port is configured."""
     global _http_server
     if port is None:
-        port = os.environ.get("SRJT_METRICS_PORT")
+        port = knobs.get("SRJT_METRICS_PORT")
         if not port:
             return None
     port = int(port)

@@ -24,7 +24,9 @@ import threading
 import time
 from typing import Optional
 
-_lock = threading.Lock()
+from ..analysis import sanitize
+
+_lock = sanitize.tracked_lock("utils.structured_log")
 _mode: str = os.environ.get("SPARK_RAPIDS_TPU_LOG", "off").lower()
 _path: Optional[str] = os.environ.get("SPARK_RAPIDS_TPU_LOG_FILE")
 _stream = None

@@ -24,7 +24,14 @@ import threading
 # weakref handled by hostcache.WeakIdMemo
 from typing import Any
 
+from ..analysis import sanitize
+
+# The sync counter is bumped from every exec-runtime worker thread; an
+# unguarded `_count += 1` is a read-modify-write that loses updates under
+# contention (found by srjt_lint conc-global-augassign; regression:
+# tests/test_analysis.py::test_sync_count_thread_safe).
 _count = 0
+_count_mu = sanitize.tracked_lock("utils.syncs.count")
 
 # --- capture/replay: compile a whole multi-op plan into ONE jit program ----
 #
@@ -97,7 +104,8 @@ def scalar(x) -> int:
         v = _tls.tape[_tls.tape_pos]
         _tls.tape_pos += 1
         return v
-    _count += 1
+    with _count_mu:
+        _count += 1
     v = int(x)
     if mode() == "capture":
         _tls.tape.append(v)
@@ -109,7 +117,8 @@ def note_sync(k: int = 1) -> None:
     :func:`scalar` (e.g. a stacked size-vector pull) — keeps the
     syncs-per-query funnel honest for non-scalar transfers."""
     global _count
-    _count += k
+    with _count_mu:
+        _count += k
 
 
 def sync_count() -> int:
@@ -118,7 +127,8 @@ def sync_count() -> int:
 
 def reset_sync_count() -> int:
     global _count
-    old, _count = _count, 0
+    with _count_mu:
+        old, _count = _count, 0
     return old
 
 

@@ -78,7 +78,8 @@ def _tables_equal(a, b):
 
 def _scan(raw, columns, dict_on: bool):
     from spark_rapids_jni_tpu.parquet import device_scan
-    old = os.environ.get("SRJT_DICT_STRINGS")
+    # save/restore around the A/B write below, not a config read
+    old = os.environ.get("SRJT_DICT_STRINGS")  # srjt-lint: disable=knob-env
     os.environ["SRJT_DICT_STRINGS"] = "1" if dict_on else "0"
     try:
         return device_scan.scan_table(raw, columns=columns)

@@ -112,10 +112,10 @@ def main():
     from benchmarks import tpcds_data
     from spark_rapids_jni_tpu.models import tpcds
     from spark_rapids_jni_tpu.models.compiled import compile_query
-    from spark_rapids_jni_tpu.utils import metrics, syncs
+    from spark_rapids_jni_tpu.utils import knobs, metrics, syncs
 
-    use_metrics = os.environ.get("SRJT_QB_METRICS", "1") not in ("0", "off")
-    trace_dir = os.environ.get("SRJT_QB_TRACE_DIR")
+    use_metrics = knobs.get("SRJT_QB_METRICS")
+    trace_dir = knobs.get("SRJT_QB_TRACE_DIR")
     if trace_dir:
         os.makedirs(trace_dir, exist_ok=True)
 
@@ -145,7 +145,7 @@ def main():
     # fresh process that reloads tables and SKIPS completed queries.
     # Queries that crashed twice are abandoned (a deterministic
     # chip-killer must not re-exec forever).
-    if os.environ.get("SRJT_QB_RESUME") == "1" and os.path.exists(out_path):
+    if knobs.get("SRJT_QB_RESUME") == "1" and os.path.exists(out_path):
         with open(out_path) as f:
             prior = json.load(f)
         RESULTS["queries"].update(prior.get("queries", {}))
@@ -163,7 +163,7 @@ def main():
         caller must STOP — the poisoned backend fails every dispatch)."""
         with open(out_path, "w") as f:
             json.dump(RESULTS, f, indent=1)
-        tries = int(os.environ.get("SRJT_QB_TRIES", "0"))
+        tries = knobs.get("SRJT_QB_TRIES")
         if tries >= 6:
             print("re-exec budget exhausted; stopping", flush=True)
             RESULTS["budget_exhausted"] = True
@@ -182,8 +182,7 @@ def main():
     for name in chosen:
         prev = RESULTS["queries"].get(name)
         if prev is not None:
-            steady_on = os.environ.get("SRJT_QB_STEADY", "1") \
-                not in ("0", "off")
+            steady_on = knobs.get("SRJT_QB_STEADY")
             done = ("steady_ms" in prev
                     or ("steady_skipped" in prev
                         and not (steady_on
@@ -233,7 +232,7 @@ def main():
             entry["cold_wall_s"] = round(time.perf_counter() - t0, 2)
             entry["cold_syncs"] = syncs.reset_sync_count()
             entry["tape_len"] = len(cq.tape)
-            if os.environ.get("SRJT_QB_EXPLAIN") == "1":
+            if knobs.get("SRJT_QB_EXPLAIN"):
                 # planner EXPLAIN for queries that have a plan-tree port
                 try:
                     from spark_rapids_jni_tpu.models import tpcds_plans
@@ -297,8 +296,8 @@ def main():
             # STEADY_LONG members run anyway with reduced trip counts
             # (1 vs 3 iterations) so the ROADMAP coverage gap closes
             # without the unbounded loop.
-            steady_cap = float(os.environ.get("SRJT_QB_STEADY_CAP", "10"))
-            if os.environ.get("SRJT_QB_STEADY", "1") in ("0", "off"):
+            steady_cap = knobs.get("SRJT_QB_STEADY_CAP")
+            if not knobs.get("SRJT_QB_STEADY"):
                 entry["steady_skipped"] = "disabled (SRJT_QB_STEADY=0)"
             elif entry["warm_unchecked_s"] <= steady_cap:
                 per = steady_per_iter(cq._prog, tables)

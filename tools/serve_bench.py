@@ -107,8 +107,8 @@ def main():
     qnames = (argv[2].split(",") if len(argv) > 2
               else ["q3", "q42", "q52", "q55"])
     n_requests = int(argv[3]) if len(argv) > 3 else 32
-    import os
-    workers = int(os.environ.get("SRJT_SERVE_WORKERS", "4"))
+    from spark_rapids_jni_tpu.utils import knobs
+    workers = knobs.get("SRJT_SERVE_WORKERS")
 
     from benchmarks import tpcds_data
     from spark_rapids_jni_tpu import exec as xc
@@ -268,8 +268,9 @@ def main():
     # the cross-request batching deliverable, measured.
     counter_acc = dict(metrics.snapshot()["counters"])
     sc_qps = n_requests / sc_s
+    from spark_rapids_jni_tpu.utils import knobs as _knobs
     results["batched"] = {"coalesce_window_ms": float(
-        os.environ.get("SRJT_EXEC_COALESCE_MS", "4")), "loads": {}}
+        _knobs.get("SRJT_EXEC_COALESCE_MS")), "loads": {}}
     for mult in (1, 2, 4):
         metrics.reset()
         rate = sc_qps * mult

@@ -195,7 +195,8 @@ def check_xpack_engines():
         res = xpack.from_rows_var_x(layout, b)
         record(f"xpack from_rows engages [{name}]", res is not None)
         got = convert_from_rows(b, t.schema)
-        saved = os.environ.get("SRJT_XPACK")
+        # save/restore around the A/B write below, not a config read
+        saved = os.environ.get("SRJT_XPACK")  # srjt-lint: disable=knob-env
         os.environ["SRJT_XPACK"] = "0"
         try:
             want_b = convert_to_rows(t)[0]
