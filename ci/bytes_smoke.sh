@@ -1,0 +1,83 @@
+#!/usr/bin/env bash
+# Byte-path staging smoke — scan a multi-row-group, multi-dtype parquet
+# through the round-6 raw path (slab-coalesced uploads + pipelined
+# walk/stage + forced decode donation) and through the eager path, assert
+# the tables bit-identical, and assert the pipeline actually engaged:
+# the flight ring must hold >=1 parquet.stage.flush, >=1
+# parquet.stage.overlap and >=1 parquet.scan.donate event.
+#
+# Usage: ci/bytes_smoke.sh [n_rows]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N_ROWS="${1:-200000}"
+
+echo "== bytes smoke: staged+pipelined+donated scan over $N_ROWS rows =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" SRJT_SMOKE_N="$N_ROWS" \
+python - <<'PYEOF'
+import io
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+n = int(os.environ["SRJT_SMOKE_N"])
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_jni_tpu.parquet import device_scan
+from spark_rapids_jni_tpu.utils import flight
+
+rng = np.random.default_rng(5)
+t = pa.table({
+    "qty": pa.array(rng.integers(1, 51, n).astype(np.int64)),
+    "price": pa.array((rng.random(n) * 100000).round(2)),
+    "ship": pa.array(rng.integers(8000, 9500, n).astype(np.int32)),
+    "tag": pa.array([f"tag{v}" for v in rng.integers(0, 40, n)]),
+})
+buf = io.BytesIO()
+pq.write_table(t, buf, compression="SNAPPY", row_group_size=n // 4)
+raw = buf.getvalue()
+
+
+def scan(env):
+    for k, v in env.items():
+        os.environ[k] = v
+    try:
+        return device_scan.scan_table(raw)
+    finally:
+        for k in env:
+            del os.environ[k]
+
+
+eager = scan({"SRJT_STAGE_SLABS": "0", "SRJT_SCAN_DONATE": "0"})
+
+flight.set_enabled(True)
+flight.reset()
+staged = scan({"SRJT_STAGE_SLABS": "1", "SRJT_STAGE_PIPELINE": "1",
+               "SRJT_SCAN_DONATE": "1"})
+evs = flight.events()
+
+for a, b in zip(eager.columns, staged.columns):
+    np.testing.assert_array_equal(np.asarray(a.data), np.asarray(b.data))
+    np.testing.assert_array_equal(np.asarray(a.validity_or_true()),
+                                  np.asarray(b.validity_or_true()))
+print(f"staged scan bit-identical over {staged.num_rows} rows "
+      f"x {staged.num_columns} cols")
+
+kinds = [e["kind"] for e in evs]
+flushes = [e for e in evs if e["kind"] == "parquet.stage.flush"]
+overlaps = [e for e in evs if e["kind"] == "parquet.stage.overlap"]
+donates = [e for e in evs if e["kind"] == "parquet.scan.donate"]
+assert flushes, f"no slab flush event in trace: {kinds}"
+assert overlaps, f"no walk/stage overlap event in trace: {kinds}"
+assert donates, f"no donation event in trace: {kinds}"
+slabs = sum(e["slabs"] for e in flushes)
+print(f"trace: {slabs} slab transfers, overlap "
+      f"{overlaps[-1]['overlap_ms']} ms over {overlaps[-1]['columns']} "
+      f"cols, donated {donates[-1]['bytes']} bytes "
+      f"({donates[-1]['buffers']} buffers)")
+print("bytes smoke OK")
+PYEOF

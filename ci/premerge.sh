@@ -62,6 +62,8 @@ if [[ "${1:-}" != "--skip-tests" ]]; then
     ci/stream_smoke.sh
     echo "== dict smoke (dictionary-string fast path) =="
     ci/dict_smoke.sh
+    echo "== bytes smoke (staged/pipelined/donated scan) =="
+    ci/bytes_smoke.sh
 fi
 
 echo "premerge OK"

@@ -273,9 +273,13 @@ class DictColumn(Column):
                 total = syncs.scalar(offs[-1])
                 starts = (doffs[:-1][safe] if nd
                           else jnp.zeros(self.codes.shape, jnp.int32))
+                # char→row via the marker-cumsum segment trick (one tiny
+                # scatter + cumsum) — the per-char binary search it
+                # replaces was the dict-string materialization cliff
+                # (O(total·log n), ~95% of the scan-bench wall)
+                from .rowconv.convert import _segment_of
                 elem = jnp.arange(total, dtype=jnp.int64)
-                row_of = jnp.searchsorted(offs.astype(jnp.int64), elem,
-                                          side="right") - 1
+                row_of = _segment_of(offs, int(total))
                 src = starts.astype(jnp.int64)[row_of] + (
                     elem - offs.astype(jnp.int64)[row_of])
                 chars = (self.dictionary.data[src] if nd

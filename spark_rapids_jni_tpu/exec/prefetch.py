@@ -38,6 +38,11 @@ from typing import Callable, Optional
 from ..analysis import sanitize
 from ..utils import flight, knobs, metrics
 
+# staging-tier counters attributed to each prefetch load (delta across
+# the loader call — see ``_loop``)
+_INGEST_COUNTERS = ("parquet.stage.slab_bytes", "parquet.stage.transfers",
+                    "parquet.stage.overlap_ms")
+
 
 def _register_staged(obj) -> None:
     """Spill-register every Table in a staged loader result (a Table, or
@@ -211,7 +216,23 @@ class Prefetcher:
                 continue
             try:
                 with metrics.span("exec.prefetch.load", key=str(key)):
+                    rec = metrics.recording()
+                    # ingest attribution: the byte-path staging counters a
+                    # loader bumps (slab uploads, walk/stage overlap) are
+                    # process-global — deltas across the load credit them
+                    # to THIS prefetch, so ops_report can split prefetch
+                    # latency into ingest vs everything else
+                    base = {k: metrics.counter_value(k)
+                            for k in _INGEST_COUNTERS} if rec else {}
                     slot["result"] = slot["loader"]()
+                    if rec:
+                        delta = {k.rsplit(".", 1)[-1]:
+                                 metrics.counter_value(k) - base[k]
+                                 for k in _INGEST_COUNTERS}
+                        if any(delta.values()):
+                            metrics.annotate(**delta)
+                            flight.record("exec.prefetch.ingest",
+                                          key=str(key), **delta)
                 _register_staged(slot["result"])
             except Exception as e:     # delivered to the taker
                 slot["exc"] = e

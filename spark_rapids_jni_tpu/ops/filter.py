@@ -28,9 +28,11 @@ def _segment_gather(offs: jnp.ndarray, idx: jnp.ndarray):
     from ..utils import syncs
     total = syncs.scalar(new_offs[-1])   # size resolution (capture/replay)
     starts = offs[:-1][idx]
+    # marker-cumsum segment lookup, not a per-char binary search — same
+    # cliff fix as DictColumn.materialize (string gathers walk every char)
+    from ..rowconv.convert import _segment_of
     elem_ids = jnp.arange(total, dtype=jnp.int64)
-    row_of = jnp.searchsorted(new_offs.astype(jnp.int64), elem_ids,
-                              side="right") - 1
+    row_of = _segment_of(new_offs.astype(jnp.int32), int(total))
     src = starts.astype(jnp.int64)[row_of] + (
         elem_ids - new_offs.astype(jnp.int64)[row_of])
     return src, new_offs.astype(jnp.int32)

@@ -39,13 +39,28 @@ import numpy as np
 
 from .. import types as T
 from ..column import Column, DictColumn, Table
-from ..utils import knobs, metrics
+from ..utils import flight, knobs, metrics, syncs
 from ..utils.tracing import traced
 from . import decode as D
+from . import staging
 from .footer import extract_footer_bytes
 from .thrift import parse_struct
 
 _PLAIN_PHYS = {D.PT_INT32: 4, D.PT_INT64: 8, D.PT_FLOAT: 4, D.PT_DOUBLE: 8}
+
+
+def _stage_wave(stager, *arrays):
+    """Upload host arrays in ONE coalesced slab wave when a stager is
+    given (queue all, then resolve — the first resolve flushes the whole
+    wave), else the eager per-buffer ``jnp.asarray``."""
+    if stager is None:
+        return tuple(jnp.asarray(a) for a in arrays)
+    hs = [staging.asarray(a, stager) for a in arrays]
+    return tuple(staging.resolve(h) for h in hs)
+
+
+def _resolve_args(args):
+    return tuple(staging.resolve(a) for a in args)
 
 
 def _walk_chunk_raw(file_bytes: bytes, chunk, max_def: int, max_rep: int,
@@ -262,10 +277,17 @@ def _assemble_valid(def_parts, ns, force_np: bool):
 def _u8_to_u32_flat(raw: jnp.ndarray) -> jnp.ndarray:
     """u8 [4k] → u32 [k] little-endian via wide-block strided slices —
     measured several times faster than the narrow-minor [k,4] bitcast on
-    TPU (the relayout dominates; see xpack._u8_to_u32_rows)."""
+    TPU (the relayout dominates; see xpack._u8_to_u32_rows).  Behind
+    SRJT_PALLAS_TRANSPOSE the same combine runs as a blocked Pallas
+    kernel (rowconv.xpallas.try_u8_to_u32) — bit-identical output."""
+    from ..rowconv import xpallas
     k = raw.shape[0] // 4
     pad = (-raw.shape[0]) % 512
-    b = jnp.pad(raw, (0, pad)).reshape(-1, 512)
+    b = jnp.pad(raw, (0, pad))
+    w = xpallas.try_u8_to_u32(b)
+    if w is not None:
+        return w[:k]
+    b = b.reshape(-1, 512)
     parts = [b[:, j::4].astype(jnp.uint32) for j in range(4)]
     w = (parts[0] | (parts[1] << 8) | (parts[2] << 16) | (parts[3] << 24))
     return w.reshape(-1)[:k]
@@ -404,11 +426,13 @@ def _device_bool(k: int, bits: jnp.ndarray,
     return jnp.where(valid, vals[pos], jnp.uint8(0))
 
 
-def _upload_dict(phys: int, dictionary: np.ndarray) -> jnp.ndarray:
+def _upload_dict(phys: int, dictionary: np.ndarray, stager=None):
+    """Typed dictionary page upload — a deferred slab Handle when a
+    stager is given (the spec arg resolves after the file-wide flush)."""
     if phys == D.PT_DOUBLE:
         from ..utils import f64bits
-        return jnp.asarray(f64bits.np_to_bits(dictionary))
-    return jnp.asarray(dictionary)
+        dictionary = f64bits.np_to_bits(dictionary)
+    return staging.asarray(dictionary, stager)
 
 
 def _valid_needs_np(parts) -> bool:
@@ -434,7 +458,7 @@ def _valid_np_concat(parts):
     return np.concatenate(segs)
 
 
-def _valid_device_concat(parts):
+def _valid_device_concat(parts, stager=None):
     """Device validity: per-page def-level plans expand on chip (bit
     test), all-valid pages are ones.  None when no chunk has nulls."""
     from . import rle_device as RLE
@@ -446,24 +470,24 @@ def _valid_device_concat(parts):
         if v is None:
             segs.append(jnp.ones(p[5], jnp.bool_))
         elif isinstance(v, np.ndarray):
-            segs.append(jnp.asarray(v))
+            segs.append(_stage_wave(stager, v)[0])
         else:
             for plan, k in v[1]:
                 segs.append(jnp.ones(k, jnp.bool_) if plan is None
-                            else RLE.expand_device(plan) == 1)
+                            else RLE.expand_device(plan, stager) == 1)
     return segs[0] if len(segs) == 1 else jnp.concatenate(segs)
 
 
-def _idx_device_concat(entries) -> jnp.ndarray:
+def _idx_device_concat(entries, stager=None) -> jnp.ndarray:
     """Dictionary-index entries (("plan", RunPlan) | ("np", arr)) →
     one int32 device vector; run plans expand on chip."""
     from . import rle_device as RLE
     if all(e[0] == "plan" for e in entries):
-        segs = [RLE.expand_device(e[1]) for e in entries]
+        segs = [RLE.expand_device(e[1], stager) for e in entries]
         return segs[0] if len(segs) == 1 else jnp.concatenate(segs)
-    return jnp.asarray(np.concatenate(
+    return _stage_wave(stager, np.concatenate(
         [RLE.expand_np(e[1]) if e[0] == "plan" else e[1]
-         for e in entries]).astype(np.int32))
+         for e in entries]).astype(np.int32))[0]
 
 
 @functools.partial(jax.jit, static_argnums=(3,))
@@ -492,10 +516,12 @@ def _dict_str_chars(geom, dictmat: jnp.ndarray, dict_lens: jnp.ndarray,
     """Dictionary-string column body: padded dict rows [Ds, Lw] gathered
     per output row, then packed to the Arrow chars stream + offsets with
     the xpack combine — all on device, one program."""
-    from ..rowconv import xpack
+    from ..rowconv import xpack, xpallas
     n, g, Bd, P, nwin, total = geom
     idx_full, lens_row, dst, _ = _dict_str_rows(dict_lens, idx, valid, g)
-    piece = dictmat[idx_full]                       # [n, Lw] u32 rows
+    piece = xpallas.try_gather_rows(dictmat, idx_full)
+    if piece is None:
+        piece = dictmat[idx_full]                   # [n, Lw] u32 rows
     chars = xpack._combine_to_stream(piece, lens_row, dst, n, g, Bd, P,
                                      nwin, total)
     return chars, dst
@@ -598,6 +624,39 @@ def _decode_file_jit(plan, arrays):
     return tuple(outs)
 
 
+# per-builder donate pattern over the arg tuple (validity is always the
+# LAST arg when present and is NEVER donated: the assemble closures keep
+# it alive as the Column's validity).  Every other staged input — raw
+# payload slabs, index vectors, dictionary pages, gather geometry — is
+# consumed exactly once by the decode body, so its HBM can be handed to
+# the outputs instead of doubling the scan footprint.
+_DONATE = {"plain": (True, False), "flba": (True, False),
+           "bool": (True, False), "dict": (True, True, False),
+           "pstr": (True, True, True, True),
+           "dstr": (True, True, True, False),
+           "dcode": (True, False)}
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+def _decode_file_jit_donated(plan, donated, kept):
+    """``_decode_file_jit`` with the single-use input buffers donated.
+    plan entries carry (key, statics, donate mask); the flat args split
+    into the donated tuple and the kept tuple (validity arrays)."""
+    outs = []
+    di = ki = 0
+    for key, statics, mask in plan:
+        args = []
+        for m in mask:
+            if m:
+                args.append(donated[di])
+                di += 1
+            else:
+                args.append(kept[ki])
+                ki += 1
+        outs.append(_BUILDERS[key](statics, tuple(args)))
+    return tuple(outs)
+
+
 def _dict_strings_enabled() -> bool:
     """SRJT_DICT_STRINGS: keep dictionary-encoded string columns as
     :class:`DictColumn` codes (default on; 0/off reverts to eager
@@ -605,7 +664,7 @@ def _dict_strings_enabled() -> bool:
     return knobs.get("SRJT_DICT_STRINGS")
 
 
-def _scan_dict_str(parts, jvalid, n_total: int):
+def _scan_dict_str(parts, jvalid, n_total: int, stager=None):
     """Dictionary-encoded strings fully on device (round 5).
 
     Host stages only metadata: the dict page's offsets recurrence (native
@@ -616,7 +675,7 @@ def _scan_dict_str(parts, jvalid, n_total: int):
     each row's dictionary entry into the Arrow chars stream + offsets.
     The only sync is ONE stacked packing-geometry pull — the libcudf
     dict-string decode analog (SURVEY §2.9)."""
-    from ..rowconv import xpack
+    from ..rowconv import xpack, xpallas
 
     # merge per-chunk dictionaries (usually byte-identical)
     dicts = [p[2] for p in parts]
@@ -651,7 +710,7 @@ def _scan_dict_str(parts, jvalid, n_total: int):
     idx_all = []
     off = 0
     for ci, p in enumerate(parts):
-        part_idx = _idx_device_concat(p[3])
+        part_idx = _idx_device_concat(p[3], stager)
         idx_all.append(part_idx + off if off else part_idx)
         if not same:
             off += entc[ci]
@@ -669,10 +728,10 @@ def _scan_dict_str(parts, jvalid, n_total: int):
         geom_sg = xpack.plan_segmented_gather(starts, lens, dict_offs)
         if geom_sg is None:
             return None
-        chars_dict = xpack.segmented_gather(
-            geom_sg, jnp.asarray(np.frombuffer(payload, np.uint8)),
-            jnp.asarray(starts.astype(np.int32)), jnp.asarray(lens),
-            jnp.asarray(dict_offs.astype(np.int32)))
+        jpay, jst, jln, jdo = _stage_wave(
+            stager, np.frombuffer(payload, np.uint8),
+            starts.astype(np.int32), lens, dict_offs.astype(np.int32))
+        chars_dict = xpack.segmented_gather(geom_sg, jpay, jst, jln, jdo)
     else:
         chars_dict = jnp.zeros(0, jnp.uint8)
 
@@ -683,7 +742,7 @@ def _scan_dict_str(parts, jvalid, n_total: int):
         # materialize lazily at the output boundary (DictColumn), and
         # predicates/joins/groupbys/sorts run on the codes.
         from ..utils import hostcache
-        doffs32 = jnp.asarray(dict_offs.astype(np.int32))
+        doffs32 = _stage_wave(stager, dict_offs.astype(np.int32))[0]
         hostcache.seed(doffs32, dict_offs.astype(np.int64))
         dict_col = Column(T.string, chars_dict, doffs32)
         metrics.count("plan.scan.dict_cols")
@@ -694,15 +753,27 @@ def _scan_dict_str(parts, jvalid, n_total: int):
             return DictColumn(out, dict_col, jvalid)
         return ("dcode", statics, args, assemble_codes)
 
-    g = 8
-    gidx = np.minimum(np.arange(0, Ds + g, g), Ds)
-    span = int((dict_offs[gidx[1:]] - dict_offs[gidx[:-1]]).max(initial=1))
-    B = xpack._bucket(max(span, 64), 64)
-    if B > (1 << 20):
-        return xpack._reject("dict_str_slab", B=B)
-    dictmat = xpack.extract_group_windows(
-        chars_dict, jnp.asarray(dict_offs.astype(np.int32)), Ds, g, B, Lw)
-    dict_lens = jnp.asarray(lens)
+    # padded dictionary row matrix: Pallas row extraction (host offsets,
+    # zero-padded rows — the combine masks each row to its length, so the
+    # two builds yield byte-identical chars) or the XLA group windows
+    dictmat = None
+    if total_chars:
+        xr = xpallas.try_extract_rows(chars_dict, dict_offs, Lw * 4)
+        if xr is not None:
+            dictmat = jax.lax.bitcast_convert_type(
+                xr.reshape(Ds, Lw, 4), jnp.uint32)
+    if dictmat is None:
+        g = 8
+        gidx = np.minimum(np.arange(0, Ds + g, g), Ds)
+        span = int((dict_offs[gidx[1:]]
+                    - dict_offs[gidx[:-1]]).max(initial=1))
+        B = xpack._bucket(max(span, 64), 64)
+        if B > (1 << 20):
+            return xpack._reject("dict_str_slab", B=B)
+        dictmat = xpack.extract_group_windows(
+            chars_dict, _stage_wave(stager, dict_offs.astype(np.int32))[0],
+            Ds, g, B, Lw)
+    dict_lens = _stage_wave(stager, lens)[0]
 
     # packing geometry: one stacked sync per adaptive-g try (short dict
     # entries need LARGE groups or the window combine's P cap blows —
@@ -710,6 +781,7 @@ def _scan_dict_str(parts, jvalid, n_total: int):
     gs = (8, 32, 128)
     geom = None
     for g in gs:
+        syncs.note_sync()
         stats = np.asarray(_dict_str_rows(dict_lens, idx, jvalid, g)[3])
         total, dspan, max_p = (int(x) for x in stats)
         if total >= 2**31:
@@ -746,14 +818,13 @@ def scan_column_device(file_bytes: bytes, chunks, leaf) -> Optional[Column]:
     key, statics, args, assemble = spec
     if key == "const":
         return assemble(None)
-    return assemble(_BUILDERS[key](statics, args))
+    return assemble(_BUILDERS[key](statics, _resolve_args(args)))
 
 
-def stage_column_device(file_bytes: bytes, chunks, leaf):
-    """Host staging for one column → deferred decode spec
-    (key, statics, device-arg tuple, assemble) or None (host fallback).
-    The heavy decode body runs later — alone (scan_column_device) or
-    inlined into the per-file fused program (_decode_file_jit)."""
+def _walk_column(file_bytes: bytes, chunks, leaf):
+    """Host page walk for every chunk of one column — pure host work (no
+    device calls), the producer half of the staged scan pipeline.
+    None → host fallback."""
     parts = []
     for chunk in chunks:
         part = _walk_chunk_raw(file_bytes, chunk, leaf.max_def, leaf.max_rep,
@@ -761,6 +832,24 @@ def stage_column_device(file_bytes: bytes, chunks, leaf):
         if part is None:
             return None
         parts.append(part)
+    return parts
+
+
+def stage_column_device(file_bytes: bytes, chunks, leaf, stager=None):
+    """Host staging for one column → deferred decode spec
+    (key, statics, device-arg tuple, assemble) or None (host fallback).
+    The heavy decode body runs later — alone (scan_column_device) or
+    inlined into the per-file fused program (_decode_file_jit).  With a
+    ``staging.SlabStager`` the raw page buffers queue as slab handles
+    (resolved by the caller after the file-wide flush)."""
+    parts = _walk_column(file_bytes, chunks, leaf)
+    if parts is None:
+        return None
+    return _stage_column_parts(parts, leaf, stager)
+
+
+def _stage_column_parts(parts, leaf, stager=None):
+    """Device staging from walked raw parts (the consumer half)."""
     kinds = {p[0] for p in parts}
     physes = {p[1] for p in parts}
     if len(kinds) > 1 or len(physes) > 1:
@@ -779,16 +868,17 @@ def stage_column_device(file_bytes: bytes, chunks, leaf):
     if kind == "plain_str":
         # the native offsets walker scatters by validity on HOST — np mask
         valid_np = _valid_np_concat(parts)
-        jvalid = None if valid_np is None else jnp.asarray(valid_np)
+        jvalid = (None if valid_np is None
+                  else _stage_wave(stager, valid_np)[0])
     else:
         # def levels expand ON DEVICE (bit test over the run plans)
         valid_np = None
-        jvalid = _valid_device_concat(parts)
+        jvalid = _valid_device_concat(parts, stager)
     hv = jvalid is not None
     vtail = (jvalid,) if hv else ()
 
     if kind == "dict_str":
-        return _scan_dict_str(parts, jvalid, n_total)
+        return _scan_dict_str(parts, jvalid, n_total, stager)
 
     if kind == "plain_str":
         # strings fully on device: the char bytes never round through a
@@ -837,10 +927,13 @@ def stage_column_device(file_bytes: bytes, chunks, leaf):
         if chars is not None:      # degenerate empty column: no jit body
             col0 = Column(T.string, chars, joffs, jvalid)
             return ("const", (), (), lambda _out: col0)
+        # raw chars + gather geometry stay slab HANDLES until the caller's
+        # file-wide flush — the whole file's strings ride a few transfers
         return ("pstr", (geom,),
-                (jnp.asarray(np.frombuffer(payload, np.uint8)),
-                 jnp.asarray(st.astype(np.int32)), jnp.asarray(ln),
-                 jnp.asarray(dst.astype(np.int32))),
+                (staging.asarray(np.frombuffer(payload, np.uint8), stager),
+                 staging.asarray(st.astype(np.int32), stager),
+                 staging.asarray(ln, stager),
+                 staging.asarray(dst.astype(np.int32), stager)),
                 lambda out: Column(T.string, out, joffs, jvalid))
 
     if kind == "plain_bool":
@@ -858,21 +951,23 @@ def stage_column_device(file_bytes: bytes, chunks, leaf):
             return None   # bit-misaligned chunk boundary: host path
         payload = b"".join(p[3] for p in parts)
         k = int(sum(npresent))
-        bits = jnp.asarray(np.frombuffer(payload, np.uint8))
+        bits = staging.asarray(np.frombuffer(payload, np.uint8), stager)
         return ("bool", (k, hv), (bits,) + vtail,
                 lambda out: Column(T.bool8, out, validity=jvalid))
 
     if kind == "plain":
         payload = b"".join(p[3] for p in parts)
         if is_flba:
-            raw = jnp.asarray(np.frombuffer(payload, dtype=np.uint8))
+            raw = staging.asarray(np.frombuffer(payload, dtype=np.uint8),
+                                  stager)
             return ("flba", (leaf.type_len, dt, hv), (raw,) + vtail,
                     lambda out: Column(dt, out, validity=jvalid))
         # 4/8-byte payloads are 4-aligned: the u8→u32 step is a FREE host
         # view, and the device decode is bitcasts/reshapes only
-        raw = jnp.asarray(np.frombuffer(payload, dtype=np.uint32)
-                          if len(payload) % 4 == 0
-                          else np.frombuffer(payload, dtype=np.uint8))
+        raw = staging.asarray(np.frombuffer(payload, dtype=np.uint32)
+                              if len(payload) % 4 == 0
+                              else np.frombuffer(payload, dtype=np.uint8),
+                              stager)
         return ("plain", (phys, dt, hv), (raw,) + vtail,
                 lambda out: Column(dt, out, validity=jvalid))
     else:
@@ -886,15 +981,15 @@ def stage_column_device(file_bytes: bytes, chunks, leaf):
             offset = 0
             merged = np.concatenate(dicts)
             for p in parts:
-                part_idx = _idx_device_concat(p[3])
+                part_idx = _idx_device_concat(p[3], stager)
                 idx_all.append(part_idx + offset if offset else part_idx)
                 offset += p[2].shape[0]
-            dict_dev = _upload_dict(phys, merged)
+            dict_dev = _upload_dict(phys, merged, stager)
             idx = jnp.concatenate(idx_all) if len(idx_all) > 1 \
                 else idx_all[0]
         else:
-            dict_dev = _upload_dict(phys, base)
-            idx_all = [_idx_device_concat(p[3]) for p in parts]
+            dict_dev = _upload_dict(phys, base, stager)
+            idx_all = [_idx_device_concat(p[3], stager) for p in parts]
             idx = jnp.concatenate(idx_all) if len(idx_all) > 1 \
                 else idx_all[0]
         return ("dict", (phys, dt, is_flba, hv),
@@ -1012,24 +1107,48 @@ def _prune_row_groups(groups_list, leaves, names, conds):
     return kept
 
 
+def _span_overlap_ms(a_spans, b_spans) -> float:
+    """Σ pairwise intersection of two interval lists, in milliseconds —
+    how long the host page walk ran concurrently with device staging."""
+    total = 0.0
+    for a0, a1 in a_spans:
+        for b0, b1 in b_spans:
+            total += max(0.0, min(a1, b1) - max(a0, b0))
+    return total * 1000.0
+
+
 @traced("parquet_scan_table_device")
 def scan_table(file_bytes: bytes,
                columns: Optional[list[str]] = None,
                row_groups: Optional[list[int]] = None,
-               rowgroup_predicate=None) -> Table:
+               rowgroup_predicate=None,
+               row_predicate=None) -> Table:
     """``decode.read_table`` with the device fast path per column.
 
     All device-path columns decode in ONE fused jitted program per file
     (``_decode_file_jit``; ``SRJT_FUSED_SCAN=0`` reverts to per-column
     dispatches); host-fallback columns batch through ``decode.read_table``
-    as before.
+    as before.  Raw page buffers upload through the slab stager
+    (``SRJT_STAGE_SLABS``) — a few large coalesced transfers per file —
+    and, under ``SRJT_STAGE_PIPELINE``, the host page walk of column k+1
+    overlaps the device staging of column k (a producer thread feeds a
+    bounded queue; ``parquet.stage.overlap`` flight events account the
+    concurrency).  ``SRJT_SCAN_DONATE`` donates the single-use input
+    slabs to the fused decode so the raw bytes don't double the scan's
+    HBM footprint.
 
     ``row_groups`` selects row groups by index (None = all);
     ``rowgroup_predicate`` is a list of ``(column, op, int_value)``
     conjuncts (op in eq/lt/le/gt/ge) tested against footer statistics —
     row groups provably containing no matching rows are skipped BEFORE
     any page decode (the planner's filter-pushdown target; counters
-    ``plan.scan.rowgroups_pruned`` / ``plan.scan.rowgroups_kept``)."""
+    ``plan.scan.rowgroups_pruned`` / ``plan.scan.rowgroups_kept``).
+    ``row_predicate`` (same conjunct shape, bytes literals allowed) goes
+    further under ``SRJT_FUSED_FILTER``: supported conjuncts evaluate on
+    the walked RAW parts — once per dictionary entry on dict columns —
+    and prune rows before anything uploads or decodes (``parquet.
+    rowfilter``).  The result table carries ``fused_filter_complete``
+    so the planner knows whether a re-apply is still needed."""
     import os
     meta = parse_struct(extract_footer_bytes(file_bytes))
     leaves = D._leaf_schema_elements(meta)
@@ -1061,33 +1180,142 @@ def scan_table(file_bytes: bytes,
             chunk_lists[i].append(chunks[i])
 
     fused = knobs.get("SRJT_FUSED_SCAN")
+    stager = staging.SlabStager() if staging.enabled() else None
     fallback: list[int] = []
     by_index: dict[int, Column] = {}
     deferred: list[tuple] = []          # (col index, key, statics, args,
     #                                      assemble)
-    for i in want:
-        spec = stage_column_device(file_bytes, chunk_lists[i], leaves[i])
+    filter_state = None                 # (conds, complete) once pruned
+
+    def _dispatch(i, spec):
         if spec is None:
             fallback.append(i)
-            continue
+            return
         key, statics, args, assemble = spec
         if key == "const":
             by_index[i] = assemble(None)
         elif fused:
             deferred.append((i, key, statics, args, assemble))
         else:
-            by_index[i] = assemble(_BUILDERS[key](statics, args))
+            by_index[i] = assemble(
+                _BUILDERS[key](statics, _resolve_args(args)))
+
+    use_filter = bool(row_predicate) and bool(knobs.get("SRJT_FUSED_FILTER"))
+    pipelined = (stager is not None and not use_filter
+                 and bool(knobs.get("SRJT_STAGE_PIPELINE"))
+                 and len(want) > 1)
+    if use_filter:
+        # fused scan→filter: the predicate needs every wanted column's
+        # walked parts before anything uploads; a host-fallback column
+        # would re-read the file unpruned, so any fallback aborts the
+        # prune (the planner re-applies the full mask as before)
+        from . import rowfilter
+        walked = {i: _walk_column(file_bytes, chunk_lists[i], leaves[i])
+                  for i in want}
+        if all(walked[i] is not None for i in want):
+            pruned = rowfilter.apply(row_predicate, walked, leaves, names,
+                                     want)
+            if pruned is not None:
+                walked, complete, n_kept = pruned
+                filter_state = (complete,)
+                flight.record("parquet.rowfilter", kept=n_kept,
+                              complete=complete)
+                if metrics.recording():
+                    metrics.count("parquet.rowfilter.fused_scans")
+                    metrics.count("parquet.rowfilter.rows_kept", n_kept)
+        for i in want:
+            _dispatch(i, None if walked[i] is None else
+                      _stage_column_parts(walked[i], leaves[i], stager))
+    elif pipelined:
+        import queue as _qmod
+        import threading
+        import time
+        depth = max(1, int(knobs.get("SRJT_STAGE_PIPELINE_DEPTH") or 2))
+        ch: _qmod.Queue = _qmod.Queue(maxsize=depth)
+        walk_spans: list[tuple[float, float]] = []
+
+        def _producer():
+            try:
+                for i in want:
+                    t0 = time.perf_counter()
+                    parts = _walk_column(file_bytes, chunk_lists[i],
+                                         leaves[i])
+                    walk_spans.append((t0, time.perf_counter()))
+                    ch.put((i, parts))
+            except BaseException as exc:   # re-raised by the consumer
+                ch.put((None, exc))
+
+        th = threading.Thread(target=_producer, name="srjt-scan-walk",
+                              daemon=True)
+        stage_spans: list[tuple[float, float]] = []
+        th.start()
+        try:
+            for _ in want:
+                i, parts = ch.get()
+                if i is None:
+                    raise parts
+                t0 = time.perf_counter()
+                spec = (None if parts is None else
+                        _stage_column_parts(parts, leaves[i], stager))
+                stage_spans.append((t0, time.perf_counter()))
+                _dispatch(i, spec)
+        finally:
+            # never leave the producer blocked on a bounded put
+            while th.is_alive():
+                try:
+                    ch.get_nowait()
+                except _qmod.Empty:
+                    th.join(0.05)
+            th.join()
+        overlap_ms = _span_overlap_ms(walk_spans, stage_spans)
+        flight.record("parquet.stage.overlap",
+                      overlap_ms=round(overlap_ms, 3), columns=len(want))
+        if metrics.recording():
+            metrics.count("parquet.stage.overlap_ms",
+                          int(round(overlap_ms)))
+    else:
+        for i in want:
+            _dispatch(i, stage_column_device(file_bytes, chunk_lists[i],
+                                             leaves[i], stager))
+    if stager is not None:
+        stager.flush()                 # file-wide slab wave (async)
     if deferred:
-        plan = tuple((key, statics, len(args))
-                     for _, key, statics, args, _ in deferred)
-        flat = tuple(a for _, _, _, args, _ in deferred for a in args)
+        deferred = [(i, key, statics, _resolve_args(args), assemble)
+                    for i, key, statics, args, assemble in deferred]
         # admission for the fused scan's staged input slabs (the decode
         # outputs are the table itself — not ephemeral — so only the raw
         # page/dictionary buffers are reserved)
         from ..memory import arena
-        scan_bytes = sum(int(getattr(a, "nbytes", 0) or 0) for a in flat)
+        scan_bytes = sum(int(getattr(a, "nbytes", 0) or 0)
+                         for _, _, _, args, _ in deferred for a in args)
         with arena.reserve(scan_bytes, tag="parquet.scan"):
-            outs = _decode_file_jit(plan, flat)
+            if staging.donate_enabled():
+                plan = tuple((key, statics, _DONATE[key][:len(args)])
+                             for _, key, statics, args, _ in deferred)
+                don, keep = [], []
+                for _, key, _, args, _ in deferred:
+                    for a, m in zip(args, _DONATE[key][:len(args)]):
+                        (don if m else keep).append(a)
+                don_bytes = sum(int(getattr(a, "nbytes", 0) or 0)
+                                for a in don)
+                flight.record("parquet.scan.donate", buffers=len(don),
+                              bytes=don_bytes)
+                if metrics.recording():
+                    metrics.count("parquet.scan.donated_bytes", don_bytes)
+                import warnings
+                with warnings.catch_warnings():
+                    # CPU PJRT ignores donation with a warning — forcing
+                    # the knob there is a test mode, keep it quiet
+                    warnings.filterwarnings("ignore",
+                                            message=".*[Dd]onat.*")
+                    outs = _decode_file_jit_donated(plan, tuple(don),
+                                                    tuple(keep))
+            else:
+                plan = tuple((key, statics, len(args))
+                             for _, key, statics, args, _ in deferred)
+                flat = tuple(a for _, _, _, args, _ in deferred
+                             for a in args)
+                outs = _decode_file_jit(plan, flat)
         for (i, _, _, _, assemble), out in zip(deferred, outs):
             by_index[i] = assemble(out)
     if metrics.recording():
@@ -1103,6 +1331,10 @@ def scan_table(file_bytes: bytes,
         for j, i in enumerate(fallback):
             by_index[i] = host[j]
     out = Table([by_index[i] for i in want])
+    if filter_state is not None:
+        # the planner checks this to skip the redundant re-apply: True
+        # means every conjunct was evaluated and pruned at scan time
+        out.fused_filter_complete = filter_state[0]
     # fused-scan outputs are evictable residents (HBM-arena follow-on):
     # under budget pressure the decoded columns host-spill IN PLACE and
     # fault back bit-exactly on their next op touch (no-op when the arena

@@ -213,7 +213,17 @@ def _runs_jit(bw: int, n: int, Rb: int, starts: jnp.ndarray,
     return jnp.where(is_bp[rid], bp_val, rle_vals[rid])
 
 
-def expand_device(plan: RunPlan) -> jnp.ndarray:
+def _upload(pay: np.ndarray, stager) -> jnp.ndarray:
+    """Payload upload; rides the scan's slab stager when one is given
+    (flushing whatever else is queued in the same wave — the bitstream
+    still lands in a coalesced slab rather than its own transfer)."""
+    if stager is None:
+        return jnp.asarray(pay)
+    from . import staging
+    return staging.resolve(staging.asarray(pay, stager))
+
+
+def expand_device(plan: RunPlan, stager=None) -> jnp.ndarray:
     """Expand a parsed hybrid stream to int32 [n] on device."""
     n = plan.n
     if plan.bw == 0:
@@ -224,7 +234,7 @@ def expand_device(plan: RunPlan) -> jnp.ndarray:
         # the pad amount non-negative (trailing payload is padding)
         pay = np.frombuffer(plan.payload, np.uint8)[:rows * plan.bw]
         return _bp_single_jit(plan.bw, n, rows * plan.bw,
-                              jnp.asarray(pay))
+                              _upload(pay, stager))
     R = len(plan.counts)
     Rb = _bucket(R, 4)
     starts = np.zeros(Rb + 1, np.int32)
@@ -239,4 +249,5 @@ def expand_device(plan: RunPlan) -> jnp.ndarray:
     return _runs_jit(plan.bw, n, Rb, jnp.asarray(starts),
                      jnp.asarray(is_bp), jnp.asarray(vals),
                      jnp.asarray(base),
-                     jnp.asarray(np.frombuffer(plan.payload, np.uint8)))
+                     _upload(np.frombuffer(plan.payload, np.uint8),
+                             stager))

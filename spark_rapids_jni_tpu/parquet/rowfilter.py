@@ -1,0 +1,217 @@
+"""Fused scan→filter: prune rows on the walked RAW parts, before upload.
+
+The round-5 pipeline decodes every selected row group in full and only
+then applies the planner's predicate as a device mask + gather — strings
+and wide columns materialize for rows the filter immediately discards.
+The reference pushes the predicate into the scan itself (libcudf's
+``parquet::read_parquet`` AST filter prunes rows inside the decode wave);
+the TPU-native analog works on the HOST staging tier, where the walked
+chunk parts still hold typed raw bytes:
+
+* ``plain`` INT32/INT64 payloads compare as zero-copy ``np.frombuffer``
+  views — one vectorized compare per conjunct;
+* dictionary-encoded columns evaluate the predicate ONCE PER DICTIONARY
+  ENTRY (an O(#entries) scan of the dict page), then the entry verdicts
+  broadcast over the expanded code stream — the same trick DictColumn
+  predicates use on device, applied before any byte reaches the chip;
+* ``plain_str`` equality compares per literal byte over the candidate
+  rows whose length matches (no per-row Python loop).
+
+Null rows FAIL every conjunct, matching ``plan.lower.eval_mask``
+(validity is ANDed into each condition's mask).  Pruning rewrites each
+column's parts in place of the originals — typed payload bytes fancy-
+indexed per row, string geometry filtered without touching the char
+payload (the segmented gather compacts anyway), codes pruned as an
+``("np", …)`` index entry — so the staged decode later in the scan sees
+a smaller file, bit-identical to scan-then-filter.
+
+Conjuncts the host tier cannot evaluate (float literals, ordered string
+compares, unsupported encodings) are simply left for the planner's
+re-apply; ``apply`` reports whether the pruned table is *complete*
+(every conjunct handled) so ``plan.lower`` can skip the redundant mask.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import decode as D
+from .device_scan import _PLAIN_PHYS
+
+_INT_PHYS = (D.PT_INT32, D.PT_INT64)
+
+
+def _cmp(op: str, a, v):
+    if op == "eq":
+        return a == v
+    if op == "lt":
+        return a < v
+    if op == "le":
+        return a <= v
+    if op == "gt":
+        return a > v
+    if op == "ge":
+        return a >= v
+    return None
+
+
+def _valid_np(p):
+    """A part's row validity as a host bool array (None = all valid)."""
+    v = p[4]
+    if v is None or isinstance(v, np.ndarray):
+        return v
+    from . import rle_device as RLE
+    return np.concatenate(
+        [np.ones(k, bool) if plan is None else (RLE.expand_np(plan) == 1)
+         for plan, k in v[1]])
+
+
+def _codes_np(entries) -> np.ndarray:
+    """Dictionary-index entries → one int32 code per PRESENT value."""
+    from . import rle_device as RLE
+    return np.concatenate(
+        [RLE.expand_np(e[1]) if e[0] == "plan" else np.asarray(e[1])
+         for e in entries]).astype(np.int32) if entries \
+        else np.zeros(0, np.int32)
+
+
+def _dict_entry_eq(data: bytes, offs: np.ndarray, val: bytes) -> np.ndarray:
+    """Per-entry equality against a bytes literal, straight off the RAW
+    dict page (entry j's chars start at ``offs[j] + 4*(j+1)`` — past j+1
+    length prefixes)."""
+    m = np.zeros(offs.shape[0] - 1, bool)
+    lv = len(val)
+    for j in range(m.shape[0]):
+        ln = int(offs[j + 1] - offs[j])
+        if ln == lv:
+            s = int(offs[j]) + 4 * (j + 1)
+            m[j] = data[s:s + ln] == val
+    return m
+
+
+def _part_mask(p, op: str, val):
+    """Row mask [p.n_total] for one conjunct over one walked part, or
+    None (shape outside the host tier's envelope)."""
+    kind, phys = p[0], p[1]
+    pm = None
+    if kind == "plain" and isinstance(val, int) and phys in _INT_PHYS:
+        dt = np.int32 if phys == D.PT_INT32 else np.int64
+        pm = _cmp(op, np.frombuffer(p[3], dtype=dt), val)
+    elif kind == "dict" and isinstance(val, int) and phys in _INT_PHYS:
+        ent = np.asarray(p[2])
+        if ent.ndim == 1 and ent.dtype.kind in "iu":
+            em = _cmp(op, ent, val)
+            if em is not None:
+                pm = em[_codes_np(p[3])]
+    elif kind == "dict_str" and isinstance(val, bytes) and op == "eq":
+        data, offs = p[2]
+        pm = _dict_entry_eq(data, offs, val)[_codes_np(p[3])]
+    elif kind == "plain_str" and isinstance(val, bytes) and op == "eq":
+        _payload, st, ln = p[3]
+        pm = ln == len(val)
+        if len(val) and pm.any():
+            pay = np.frombuffer(_payload, np.uint8)
+            lit = np.frombuffer(val, np.uint8)
+            cand = np.flatnonzero(pm)
+            sub = np.ones(cand.shape[0], bool)
+            base = st[cand]
+            for k in range(len(val)):
+                sub &= pay[base + k] == lit[k]
+            pm = np.zeros(pm.shape[0], bool)
+            pm[cand] = sub
+    if pm is None:
+        return None
+    valid = _valid_np(p)
+    if valid is None:
+        return np.asarray(pm, bool)
+    m = np.zeros(p[5], bool)
+    m[valid] = pm                      # null rows fail, like eval_mask
+    return m
+
+
+def _column_mask(parts, op: str, val):
+    masks = []
+    for p in parts:
+        m = _part_mask(p, op, val)
+        if m is None:
+            return None
+        masks.append(m)
+    return np.concatenate(masks) if len(masks) > 1 else masks[0]
+
+
+def _prune_part(p, leaf, keep: np.ndarray):
+    """One walked part with only the ``keep`` rows, same tuple shape."""
+    kind, phys, dictionary, body, _valid, _n = p
+    valid = _valid_np(p)
+    keep_present = keep if valid is None else keep[valid]
+    new_valid = None if valid is None else valid[keep]
+    n_new = int(keep.sum())
+    if kind == "plain":
+        width = (leaf.type_len if phys == D.PT_FIXED_LEN_BYTE_ARRAY
+                 else _PLAIN_PHYS[phys])
+        vals = np.frombuffer(body, dtype=np.dtype((np.void, width)))
+        new_body = vals[keep_present].tobytes()
+    elif kind == "plain_bool":
+        npres = keep_present.shape[0]
+        bits = np.unpackbits(np.frombuffer(body, np.uint8),
+                             bitorder="little")[:npres]
+        new_body = np.packbits(bits[keep_present],
+                               bitorder="little").tobytes()
+    elif kind == "plain_str":
+        payload, st, ln = body
+        new_body = (payload, st[keep_present], ln[keep_present])
+    elif kind in ("dict", "dict_str"):
+        codes = _codes_np(body)
+        new_body = [("np", codes[keep_present].astype(np.int32))]
+    else:
+        return None
+    return (kind, phys, dictionary, new_body, new_valid, n_new)
+
+
+def apply(conds, walked, leaves, names, want):
+    """Evaluate supported ``(column, op, literal)`` conjuncts over the
+    walked raw parts and prune every wanted column's rows.
+
+    → ``(pruned_walked, complete, n_kept)``, or None when no conjunct is
+    evaluable on this file (the caller stages the original parts and the
+    planner's mask runs as before).  ``complete`` is True when EVERY
+    conjunct was evaluated here — the planner may then skip its re-apply
+    if the conjunct list covers the whole predicate."""
+    name_to_idx = {n: i for i, n in enumerate(names)}
+    first = walked.get(want[0]) if want else None
+    if not first:
+        return None
+    n_rows = int(sum(p[5] for p in first))
+    if n_rows == 0:
+        return None
+    keep = np.ones(n_rows, bool)
+    handled = 0
+    for cname, op, val in conds:
+        ci = name_to_idx.get(cname)
+        m = None
+        if ci is not None and walked.get(ci) is not None:
+            m = _column_mask(walked[ci], op, val)
+        if m is None:
+            continue
+        keep &= m
+        handled += 1
+    if handled == 0:
+        return None
+    complete = handled == len(conds)
+    n_kept = int(keep.sum())
+    if n_kept == n_rows:
+        # nothing to prune — skip the byte rewrite; ``complete`` still
+        # lets the planner drop its (all-True) re-apply
+        return walked, complete, n_kept
+    out = {}
+    for i in want:
+        newparts = []
+        pos = 0
+        for p in walked[i]:
+            pruned = _prune_part(p, leaves[i], keep[pos:pos + p[5]])
+            pos += p[5]
+            if pruned is None:
+                return None
+            newparts.append(pruned)
+        out[i] = newparts
+    return out, complete, n_kept

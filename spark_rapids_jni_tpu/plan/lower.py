@@ -91,9 +91,13 @@ class FileCatalog:
         from ..parquet import device_scan
         full = self.schema(node.table)
         cols = list(node.columns) if node.columns is not None else list(full)
+        conds = rowgroup_conditions(node.predicate)
+        # the same conjunct list drives both pushdown tiers: row groups
+        # prune on footer statistics, surviving rows prune on the walked
+        # raw pages (parquet.rowfilter) before anything decodes
         t = device_scan.scan_table(
             self.files[node.table], columns=cols,
-            rowgroup_predicate=rowgroup_conditions(node.predicate))
+            rowgroup_predicate=conds, row_predicate=conds)
         if metrics.recording() and len(cols) < len(full):
             metrics.count("plan.scan.columns_pruned",
                           len(full) - len(cols))
@@ -145,6 +149,31 @@ def rowgroup_conditions(expr: Optional[ir.Expr]):
                 conds.append((c.col.name, "lt" if c.hi_strict else "le",
                               hi))
     return conds or None
+
+
+def _full_pushdown(expr: Optional[ir.Expr]) -> bool:
+    """True when ``rowgroup_conditions(expr)`` is EQUIVALENT to the whole
+    predicate — every conjunct is a Cmp/Between whose literals made it
+    into the condition list — not merely a necessary relaxation.  Only
+    then may a scan-side row filter replace the planner's mask."""
+    if expr is None:
+        return False
+    for c in ir.conjuncts(expr):
+        if (isinstance(c, ir.Cmp) and isinstance(c.left, ir.Col)
+                and isinstance(c.right, ir.Lit)
+                and c.op in ("==", "<", "<=", ">", ">=")):
+            if _rowgroup_literal(c.right.value) is None:
+                return False
+        elif isinstance(c, ir.Between) and isinstance(c.col, ir.Col):
+            if c.lo is None and c.hi is None:
+                return False
+            if c.lo is not None and _rowgroup_literal(c.lo) is None:
+                return False
+            if c.hi is not None and _rowgroup_literal(c.hi) is None:
+                return False
+        else:
+            return False
+    return True
 
 
 # --- expression evaluation --------------------------------------------------
@@ -272,7 +301,16 @@ def _execute(node: ir.Plan, catalog, record_stats: bool):
     if isinstance(node, ir.Scan):
         t, names = catalog.scan(node)
         if node.predicate is not None:
-            t = apply_boolean_mask(t, eval_mask(node.predicate, t, names))
+            if (getattr(t, "fused_filter_complete", False)
+                    and _full_pushdown(node.predicate)):
+                # the scan already evaluated every conjunct on the raw
+                # pages and pruned the rows — the mask here would be
+                # all-True, skip the redundant gather
+                if metrics.recording():
+                    metrics.count("plan.scan.filter_fused")
+            else:
+                t = apply_boolean_mask(t, eval_mask(node.predicate, t,
+                                                    names))
     elif isinstance(node, ir.Filter):
         t, names = _execute(node.child, catalog, record_stats)
         t = apply_boolean_mask(t, eval_mask(node.predicate, t, names))

@@ -569,6 +569,12 @@ def _segment_of(starts: jnp.ndarray, total: int) -> jnp.ndarray:
     return jnp.cumsum(markers)
 
 
+# shared outside rowconv (DictColumn.materialize, ops.filter string
+# gathers, rle_device run lookup): every per-position binary search in
+# the package routes through this one primitive
+segment_of = _segment_of
+
+
 @functools.partial(jax.jit, static_argnums=0)
 def _var_fixed_region(layout: RowLayout, datas: tuple[jnp.ndarray, ...],
                       str_offsets: tuple[jnp.ndarray, ...],

@@ -62,6 +62,15 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _x64_off():
+    """``jax.enable_x64(False)`` context across jax versions (0.4.x ships
+    it as ``jax.experimental.disable_x64``)."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(False)
+    from jax.experimental import disable_x64
+    return disable_x64()
+
+
 def _pow2_bucket(x: int, lo: int = 8) -> int:
     """Round up to a power of two (≥ lo).
 
@@ -224,7 +233,7 @@ def pack_rows(dense: jnp.ndarray, row_offsets: np.ndarray,
     (int64 columns), but PrefetchScalarGridSpec and ``pltpu.roll`` fail to
     legalize under x64, and everything here is 32-bit anyway.
     """
-    with jax.enable_x64(False):
+    with _x64_off():
         return _pack_rows_impl(dense, row_offsets, block_bytes)
 
 
@@ -355,7 +364,7 @@ def unpack_rows(flat: jnp.ndarray, row_offsets: np.ndarray, M: int,
     zero-padded dense rows u8 [n, M].  Byte-granular offsets.
 
     Runs under ``jax.enable_x64(False)`` — see :func:`pack_rows`."""
-    with jax.enable_x64(False):
+    with _x64_off():
         return _unpack_rows_impl(flat, row_offsets, M, rows_per_block)
 
 
@@ -470,7 +479,7 @@ def segmented_copy(src: jnp.ndarray, src_offs: np.ndarray,
     alignment requirements.  Runs under ``jax.enable_x64(False)`` — see
     :func:`pack_rows`.
     """
-    with jax.enable_x64(False):
+    with _x64_off():
         return _segmented_copy_impl(src, src_offs, dst_offs, sizes,
                                     dst_size, block_bytes)
 

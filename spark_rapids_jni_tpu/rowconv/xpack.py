@@ -211,7 +211,16 @@ def pack_windows(dense: jnp.ndarray, dst_w: jnp.ndarray, total_w: int,
 
     Output-window-centric: window w takes rows fr(w)..fr(w)+P-1 as ONE
     gathered slab from a P-wide shifted view of ``dense``, then places each
-    row with a fused word-shift + mask + OR."""
+    row with a fused word-shift + mask + OR.
+
+    ``SRJT_PALLAS_PACKWIN`` routes the same placement through the Mosaic
+    kernel (one VMEM row-window DMA per 4 KiB output block instead of the
+    P-wide slab re-read); geometry outside the kernel envelope falls back
+    here."""
+    from . import xpallas
+    xout = xpallas.try_pack_windows(dense, dst_w, total_w, P, nwin)
+    if xout is not None:
+        return xout
     n, Mw = dense.shape
     # P-row slab view: VP[r] = dense[r] ++ dense[r+1] ++ … ++ dense[r+P-1]
     padded = jnp.pad(dense, ((0, P), (0, 0)))

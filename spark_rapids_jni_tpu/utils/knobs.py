@@ -275,6 +275,14 @@ register("SRJT_FIXED_CONCAT", None, _opt_str,
 register("SRJT_XPACK", "1", _on_unless_0_off,
          "native xpack fast path for row conversion; `0`/`off` falls "
          "back to the reference composer", "rowconv")
+register("SRJT_PALLAS_PACKWIN", "0", _str,
+         "Pallas `pack_windows` kernel for the var-width row combine: "
+         "`1`/`on` on TPU, `interpret` forces interpreter mode (CI "
+         "parity), default off → lax window combine", "rowconv")
+register("SRJT_PALLAS_EXTRACT", "0", _str,
+         "Pallas `extract_group_windows` kernel for var-width char "
+         "extraction: `1`/`on` on TPU, `interpret` forces interpreter "
+         "mode (CI parity), default off → lax slab gather", "rowconv")
 
 # plan optimizer
 register("SRJT_PLAN_OPT", "1", _not_0,
@@ -293,6 +301,38 @@ register("SRJT_DICT_STRINGS", "1", _on_unless_0_off,
 register("SRJT_FUSED_SCAN", "1", _on_unless_0_off,
          "fused multi-row-group scan assembly; `0`/`off` decodes row "
          "groups independently", "parquet")
+register("SRJT_STAGE_SLABS", "1", _on_unless_0_off,
+         "coalesced h2d staging: a row group's raw pages/levels/"
+         "dictionaries upload as a few large slabs instead of per-buffer "
+         "`device_put`s; `0`/`off` reverts to per-buffer uploads",
+         "parquet")
+register("SRJT_STAGE_SLAB_BYTES", "64m", parse_bytes,
+         "slab size cap for the coalescing stager (`64m` forms); a flush "
+         "splits into multiple transfers past it", "parquet")
+register("SRJT_STAGE_PIPELINE", "1", _on_unless_0_off,
+         "double-buffered row-group pipeline: walk/decompress row group "
+         "k+1 on host while k's slabs transfer; `0`/`off` stages "
+         "synchronously", "parquet")
+register("SRJT_STAGE_PIPELINE_DEPTH", "2", _int,
+         "row groups walked ahead of the transfer stage (pipeline "
+         "buffer bound)", "parquet")
+register("SRJT_SCAN_DONATE", "auto", _str,
+         "donate staged input slabs to the fused decode program (XLA "
+         "reuses the buffers for outputs): `auto` = non-CPU backends, "
+         "`1`/`on` forces, `0`/`off` disables", "parquet")
+register("SRJT_FUSED_FILTER", "1", _on_unless_0_off,
+         "fused scan→filter: planner row predicates prune rows on the "
+         "staged host metadata (dictionary entries evaluated once, codes "
+         "masked) before strings/wide columns materialize; `0`/`off` "
+         "decodes all rows and filters after", "parquet")
+register("SRJT_PALLAS_DICT_GATHER", "0", _str,
+         "Pallas dictionary-index gather in the scan decode: `1`/`on` on "
+         "TPU, `interpret` forces interpreter mode (CI parity), default "
+         "off → lax gather", "parquet")
+register("SRJT_PALLAS_TRANSPOSE", "0", _str,
+         "Pallas byte→word transpose for PLAIN payload decode: `1`/`on` "
+         "on TPU, `interpret` forces interpreter mode (CI parity), "
+         "default off → strided lax transpose", "parquet")
 
 # streaming
 register("SRJT_STREAM_ALLOW_APPROX", "0", _opt_in,

@@ -62,6 +62,39 @@ def _attribution_lines(hists: dict) -> list[str]:
     return out
 
 
+# byte-path ingest counters (round 6): where scan bytes went before any
+# query ran — slab staging, fused row filter, pallas kernel dispatch
+_INGEST = (
+    ("parquet.stage.slab_bytes", "slab bytes shipped", _fmt_bytes),
+    ("parquet.stage.transfers", "slab transfers", None),
+    ("parquet.stage.overlap_ms", "walk/stage overlap ms", None),
+    ("parquet.scan.donated_bytes", "decode-donated bytes", _fmt_bytes),
+    ("parquet.rowfilter.fused_scans", "fused-filter scans", None),
+    ("parquet.rowfilter.rows_kept", "fused-filter rows kept", None),
+    ("rowconv.pallas.hits", "pallas kernel hits", None),
+    ("rowconv.pallas.fallbacks", "pallas lax fallbacks", None),
+)
+
+
+def _ingest_lines(counters: dict, events: list) -> list[str]:
+    """Ingest attribution: the staging-tier counters, plus the per-load
+    deltas the prefetcher stamped on its ``exec.prefetch.ingest`` events
+    (how much of each prefetch load was byte-path work)."""
+    out = []
+    for name, label, fmt in _INGEST:
+        v = counters.get(name)
+        if v:
+            out.append(f"  {label:<26} {fmt(v) if fmt else f'{v:.0f}'}")
+    for ev in [e for e in events
+               if e.get("kind") == "exec.prefetch.ingest"][-5:]:
+        out.append(
+            f"  prefetch[{ev.get('key')}]: "
+            f"{_fmt_bytes(ev.get('slab_bytes', 0))} staged in "
+            f"{ev.get('transfers', 0):.0f} transfers, "
+            f"overlap {ev.get('overlap_ms', 0):.0f} ms")
+    return out or ["  (no byte-path ingest activity recorded)"]
+
+
 def _slo_lines(slo: dict) -> list[str]:
     th = slo.get("thresholds") or {}
     if not th:
@@ -104,6 +137,8 @@ def report(sched) -> str:
     lines.extend(_slo_lines(st["slo"]))
     lines.append("== latency attribution ==")
     lines.extend(_attribution_lines(snap["histograms"]))
+    lines.append("== ingest attribution ==")
+    lines.extend(_ingest_lines(snap.get("counters") or {}, flight.events()))
     lines.append("== flight ring (newest last) ==")
     for ev in flight.events(last=15):
         extra = {k: v for k, v in ev.items()
@@ -139,6 +174,9 @@ def report_incident(path: str) -> str:
     hists = (snap.get("metrics") or {}).get("histograms") or {}
     lines.append("== latency attribution ==")
     lines.extend(_attribution_lines(hists))
+    lines.append("== ingest attribution ==")
+    lines.extend(_ingest_lines(
+        (snap.get("metrics") or {}).get("counters") or {}, evs))
     return "\n".join(lines)
 
 

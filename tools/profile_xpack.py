@@ -163,6 +163,58 @@ def main():
     per_pack = _chained(pack_stage, (dense0, dst_w))
     record("pack_windows", per_pack, total_b)
 
+    # --- pallas kernel entries (round 6) -----------------------------------
+    # Each knobbed Mosaic kernel at the SAME geometry as its lax stage
+    # above, so before/after is a same-row comparison.  Off-knob and
+    # geometry-fallback cases record a skip marker instead of a number —
+    # the JSON documents the fallback ladder, never fakes a kernel time.
+    from spark_rapids_jni_tpu.rowconv import xpallas
+
+    def pallas_entry(name, knob, fn, nbytes):
+        m = xpallas.mode(knob)
+        if m == "off":
+            RESULTS["stages"].append({"name": name,
+                                      "skipped": f"{knob} off"})
+            print(f"  {name}: skipped ({knob} off)", flush=True)
+            return
+        out = fn()                                   # warm / envelope check
+        if out is None:
+            RESULTS["stages"].append({"name": name,
+                                      "skipped": "geometry fallback"})
+            print(f"  {name}: geometry outside kernel envelope", flush=True)
+            return
+        jax.block_until_ready(out)
+        reps = 2 if m == "interpret" else 10
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            jax.block_until_ready(fn())
+        per = (time.perf_counter() - t0) / reps
+        record(name, per, nbytes, f"mode={m}")
+
+    pallas_entry("pallas.pack_windows", "SRJT_PALLAS_PACKWIN",
+                 lambda: xpallas.try_pack_windows(dense0, dst_w, total_w,
+                                                  P, nwin), total_b)
+    off0 = col_offs[0]
+    _B0, Lw0 = colgeo[0]
+    if Lw0:
+        pallas_entry("pallas.extract_rows", "SRJT_PALLAS_EXTRACT",
+                     lambda: xpallas.try_extract_rows(
+                         datas[var_idx[0]].reshape(-1), off0, Lw0 * 4),
+                     int(off0[-1]))
+    rng0 = np.random.default_rng(7)
+    u8len = -(n * fpv) // 2048 * -2048
+    flat_u8 = jnp.asarray(rng0.integers(0, 256, u8len, dtype=np.int64)
+                          .astype(np.uint8))
+    pallas_entry("pallas.u8_to_u32", "SRJT_PALLAS_TRANSPOSE",
+                 lambda: xpallas.try_u8_to_u32(flat_u8), u8len)
+    Dn, Wd = 4096, 32
+    mat0 = jnp.asarray(rng0.integers(0, 2**32, (Dn, Wd), dtype=np.int64)
+                       .astype(np.uint32))
+    idx0 = jnp.asarray(rng0.integers(0, Dn, 200_000).astype(np.int32))
+    pallas_entry("pallas.gather_rows", "SRJT_PALLAS_DICT_GATHER",
+                 lambda: xpallas.try_gather_rows(mat0, idx0),
+                 200_000 * Wd * 4)
+
     # --- full program ------------------------------------------------------
     def full(a):
         ds, so, va = a
