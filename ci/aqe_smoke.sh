@@ -1,0 +1,164 @@
+#!/usr/bin/env bash
+# Adaptive-query-execution smoke — the AQE analog of ci/plan_smoke.sh,
+# run with the STRICT runtime sanitizer on: (1) with SRJT_AQE=0 the
+# lowered execution is byte-for-byte the static path; (2) with SRJT_AQE=1
+# an adversarially-ordered star join replans from observed cardinalities
+# and an out-of-range dense prior flips the join engine, both
+# bit-identical to the static plan; (3) the skew-salted repartition
+# sub-join over the 8-device mesh fires and merges exactly; (4) the
+# cardinality-stats sidecar round-trips through its JSON file.
+# Artifacts land in target/aqe_smoke/ for workflow upload.
+#
+# Usage: ci/aqe_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=target/aqe_smoke
+mkdir -p "$OUT"
+
+echo "== aqe smoke (strict sanitizer) =="
+XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+SRJT_SANITIZE=strict \
+SPARK_RAPIDS_TPU_METRICS=1 \
+SRJT_SMOKE_OUT="$OUT" \
+python - <<'PYEOF'
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+out = os.environ["SRJT_SMOKE_OUT"]
+
+import numpy as np
+import jax.numpy as jnp
+
+import spark_rapids_jni_tpu as sr
+from spark_rapids_jni_tpu.column import Column, Table, force_column
+from spark_rapids_jni_tpu.plan import adaptive, ir, lower, rules
+from spark_rapids_jni_tpu.plan import stats as plan_stats
+from spark_rapids_jni_tpu.utils import metrics
+
+rng = np.random.default_rng(5)
+n = 20_000
+
+
+def _col(a):
+    return Column.from_numpy(np.asarray(a))
+
+
+tables = {
+    "fact": Table([_col(rng.integers(0, 5000, n).astype(np.int64)),
+                   _col(rng.integers(0, 400, n).astype(np.int64)),
+                   _col(rng.integers(1, 9, n).astype(np.int64))]),
+    "dim_big": Table([_col(np.arange(5000, dtype=np.int64)),
+                      _col((np.arange(5000) % 11).astype(np.int32))]),
+    "dim_small": Table([_col(np.arange(24, dtype=np.int64)),
+                        _col((np.arange(24) % 3).astype(np.int32))]),
+}
+schemas = {"fact": ["f_big_sk", "f_small_sk", "f_qty"],
+           "dim_big": ["big_sk", "b_tag"],
+           "dim_small": ["small_sk", "s_tag"]}
+
+# adversarial order: big dim first
+tree = ir.FusedJoinAggregate(
+    ir.Join(ir.Scan("fact"), ir.Scan("dim_big"), ("f_big_sk",), ("big_sk",)),
+    ir.Scan("dim_small"), ("f_small_sk",), ("small_sk",),
+    ("b_tag",), (("f_qty", "sum", "total"), ("f_qty", "count", "cnt")))
+
+
+def rows(t):
+    return [force_column(c).to_numpy().tolist() for c in t]
+
+
+# (1) AQE off → byte-for-byte the static path
+os.environ["SRJT_AQE"] = "0"
+cat = lower.TableCatalog(tables, schemas)
+static = lower.execute(tree, cat, record_stats=False)
+off = lower.execute(tree, lower.TableCatalog(tables, schemas),
+                    record_stats=False)
+assert rows(static) == rows(off)
+print("AQE off: static path byte-identical")
+
+# (2) AQE on → replan fires, result bit-identical
+os.environ["SRJT_AQE"] = "1"
+metrics.set_enabled(True)
+metrics.reset()
+report = adaptive.AdaptiveReport()
+got = adaptive.execute_adaptive(tree, lower.TableCatalog(tables, schemas),
+                                record_stats=False, report=report)
+assert rows(got) == rows(static), "adaptive result differs from static"
+assert metrics.counter_value("plan.aqe.replan.fired") >= 1
+kinds = {d.kind for d in report.decisions()}
+assert "replan" in kinds, kinds
+print("AQE on: replan fired, bit-identical —",
+      sorted(kinds))
+
+# engine flip: sparse build keys under a dense-looking span
+sp_tables = {
+    "fact": Table([_col(rng.integers(0, 15_000, n).astype(np.int64)),
+                   _col(rng.integers(1, 9, n).astype(np.int64))]),
+    "dim": Table([_col(rng.permutation(15_000)[:600].astype(np.int64)),
+                  _col((np.arange(600) % 7).astype(np.int32))]),
+}
+sp_schemas = {"fact": ["f_sk", "f_qty"], "dim": ["d_sk", "d_tag"]}
+sp_tree = ir.FusedJoinAggregate(
+    ir.Scan("fact"), ir.Scan("dim"), ("f_sk",), ("d_sk",),
+    ("d_tag",), (("f_qty", "sum", "total"),))
+os.environ["SRJT_AQE"] = "0"
+sp_static = lower.execute(sp_tree, lower.TableCatalog(sp_tables, sp_schemas),
+                          record_stats=False)
+os.environ["SRJT_AQE"] = "1"
+sp_got = adaptive.execute_adaptive(
+    sp_tree, lower.TableCatalog(sp_tables, sp_schemas), record_stats=False)
+assert rows(sp_got) == rows(sp_static)
+flips = metrics.counter_value("plan.aqe.engine_flip.fired")
+assert flips >= 1, "engine flip did not fire"
+print("engine flip fired:", int(flips), "— bit-identical")
+
+# (3) skew-salted repartition sub-join over the mesh
+from spark_rapids_jni_tpu.parallel import make_mesh
+from spark_rapids_jni_tpu.parallel import repartition_join as rj
+
+mesh = make_mesh(8, "data")
+ns, nb, G = 16_384, 256, 8
+fk = rng.integers(0, nb, ns).astype(np.int64)
+fk[rng.random(ns) < 0.7] = 3
+fv = rng.integers(-20, 20, ns).astype(np.int64)
+bk = np.arange(nb, dtype=np.int64)
+bg = (bk % G).astype(np.int32)
+args = (mesh, (sr.int64, sr.int64), (sr.int64, sr.int32), 0, 0, 1, 1, G,
+        (jnp.asarray(fk), jnp.asarray(fv)), jnp.ones((ns, 2), bool),
+        (jnp.asarray(bk), jnp.asarray(bg)), jnp.ones((nb, 2), bool))
+s1, c1, d1 = rj.repartition_join_agg_auto(*args, salt=1)
+sA, cA, dA = rj.repartition_join_agg_auto(*args)
+assert int(np.asarray(d1)) == 0 and int(np.asarray(dA)) == 0
+assert (np.asarray(s1) == np.asarray(sA)).all()
+assert (np.asarray(c1) == np.asarray(cA)).all()
+fired = metrics.counter_value("plan.aqe.skew_split.fired")
+assert fired >= 1, "skew split did not fire"
+print("skew split fired, salted merge exact")
+
+# (4) cardinality-stats sidecar roundtrip
+side = os.path.join(out, "stats_sidecar.json")
+st = plan_stats.CardinalityStats(max_entries=16)
+st.observe("plan:aaaa", 123)
+st.observe("plan:bbbb", 456)
+assert st.save_sidecar(side)
+st2 = plan_stats.CardinalityStats(max_entries=16)
+assert st2.load_sidecar(side) == 2
+assert dict(st2._rows) == {"plan:aaaa": 123, "plan:bbbb": 456}
+print("stats sidecar roundtrip OK")
+
+with open(os.path.join(out, "explain.txt"), "w") as f:
+    f.write(rules.explain(tree, schemas, adaptive_report=report))
+with open(os.path.join(out, "counters.json"), "w") as f:
+    snap = metrics.snapshot()
+    json.dump({k: v for k, v in snap["counters"].items()
+               if k.startswith(("plan.aqe", "shuffle."))}, f, indent=1)
+os.environ["SRJT_AQE"] = "0"
+print("artifacts:", out)
+PYEOF
+
+echo "aqe smoke OK"

@@ -58,6 +58,8 @@ if [[ "${1:-}" != "--skip-tests" ]]; then
     ci/chaos_smoke.sh
     echo "== plan smoke (query planner) =="
     ci/plan_smoke.sh
+    echo "== aqe smoke (adaptive query execution) =="
+    ci/aqe_smoke.sh
     echo "== stream smoke (incremental maintenance) =="
     ci/stream_smoke.sh
     echo "== dict smoke (dictionary-string fast path) =="

@@ -143,7 +143,13 @@ class PlanCache:
         admission requests running under ``force_engine``: a tape
         recorded on the dense join path would misalign when replayed
         with the engine forced, so the two variants must never share an
-        entry."""
+        entry.  AQE qfns (``plan.adaptive.compile_adaptive_plan``) carry
+        their mode in ``qfn.aqe_variant``; it is folded into the variant
+        here so flipping ``SRJT_AQE`` between requests can never adopt a
+        tape captured in the other mode."""
+        aqe = getattr(qfn, "aqe_variant", "")
+        if aqe:
+            variant = f"{variant}+{aqe}" if variant else aqe
         fp, arrays = C.plan_key(tables)
         key = (name, variant, fp)
         skey = None

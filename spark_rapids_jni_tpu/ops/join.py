@@ -83,6 +83,10 @@ def _join_indices(lcols: list, rcols: list, how: str):
     # on the key buffers' identity; null build keys are dropped outright
     plan = join_plan.plan_keys(lcols, rcols)
     ix = join_plan.build_index(plan.rdata, plan.rvalid, plan.dense_ok)
+    if metrics.recording() and ix.max_run > 0:
+        # hottest build key's row count — the AQE skew signal (free: the
+        # dense uniqueness test already synced it)
+        metrics.observe("join.build_index.max_run", ix.max_run)
     lo, counts = join_plan.probe_counts(ix, plan.ldata, plan.lvalid)
     nr = ix.row_ids.shape[0]
 

@@ -108,6 +108,10 @@ class BuildIndex(NamedTuple):
     lut_lo: Optional[jnp.ndarray]        # [span] slot → start into row_ids
     lut_cnt: Optional[jnp.ndarray]       # [span] slot → run length
     unique: bool                         # dense: every slot holds ≤ 1 row
+    max_run: int = 0                     # dense: hottest key's row count
+    #   (free: the uniqueness test already syncs max(lut_cnt); 0 = unknown
+    #   on the sorted path).  The adaptive executor reads this as its skew
+    #   signal — see skew_stats().
 
 
 def _index_nbytes(ix: "BuildIndex") -> int:
@@ -179,11 +183,11 @@ class _IndexCache:
                 mspill.touch(("join_index",) + key)
                 return e["value"]
             lanes = e["payload"].get()          # fault back (bit-exact)
-            kind, n_valid, kmin, span, unique = e["meta"]
+            kind, n_valid, kmin, span, unique, max_run = e["meta"]
             e["value"] = BuildIndex(kind, n_valid, lanes["row_ids"],
                                     lanes["sorted_keys"], kmin, span,
                                     lanes["lut_lo"], lanes["lut_cnt"],
-                                    unique)
+                                    unique, max_run)
             self._device_bytes += e["nbytes"]
             mspill.register(("join_index",) + key, e["nbytes"],
                             "join.build_index", e["payload"].spill)
@@ -223,7 +227,7 @@ class _IndexCache:
         entry = {"refs": refs, "value": ix, "payload": payload,
                  "nbytes": payload.nbytes,
                  "meta": (ix.kind, ix.n_valid, ix.kmin, ix.span,
-                          ix.unique)}
+                          ix.unique, ix.max_run)}
 
         def _spiller(e=entry):
             with self._lock():                  # reentrant under reclaim
@@ -332,7 +336,8 @@ def _build_index(data, valid, try_dense: bool, must_dense: bool):
     slot = jnp.clip(slot64, 0, span - 1).astype(jnp.int32)
     lut_cnt = jnp.zeros(span, jnp.int32).at[slot].add(ok.astype(jnp.int32))
     lut_lo = (jnp.cumsum(lut_cnt) - lut_cnt).astype(jnp.int32)
-    unique = syncs.scalar(jnp.max(lut_cnt)) <= 1
+    max_run = syncs.scalar(jnp.max(lut_cnt))
+    unique = max_run <= 1
     if unique:
         # no sort anywhere: each valid row scatters straight to its slot
         tgt = jnp.where(ok, lut_lo[slot].astype(jnp.int64),
@@ -342,7 +347,7 @@ def _build_index(data, valid, try_dense: bool, must_dense: bool):
     else:
         row_ids, _ = _key_sorted_order(data, valid, n_valid)
     return BuildIndex("dense", n_valid, row_ids, None, int(kmin), int(span),
-                      lut_lo, lut_cnt, bool(unique))
+                      lut_lo, lut_cnt, bool(unique), int(max_run))
 
 
 def extend_build_index(ix: BuildIndex, delta_data, delta_valid,
@@ -396,11 +401,12 @@ def extend_build_index(ix: BuildIndex, delta_data, delta_valid,
         row_ids = jnp.zeros(n_total, jnp.int64) \
             .at[old_pos].set(ix.row_ids) \
             .at[delta_pos].set(jnp.int64(base_n) + dorder.astype(jnp.int64))
-        unique = bool(syncs.scalar(jnp.max(new_cnt)) <= 1)
+        max_run = syncs.scalar(jnp.max(new_cnt))
+        unique = bool(max_run <= 1)
         if metrics.recording():
             metrics.count("join.build_index.extended")
         return BuildIndex("dense", n_total, row_ids, None, ix.kmin, ix.span,
-                          new_lo, new_cnt, unique)
+                          new_lo, new_cnt, unique, int(max_run))
 
 
 def probe_counts(ix: BuildIndex, ldata, lvalid):
@@ -421,6 +427,25 @@ def probe_counts(ix: BuildIndex, ldata, lvalid):
     if lvalid is not None:
         counts = jnp.where(lvalid, counts, 0)
     return lo, counts
+
+
+def skew_stats(ix: BuildIndex) -> Optional[dict]:
+    """Hot-key summary from the dense CSR histogram, or None when the
+    index carries no histogram (sorted engine, or empty build side).
+
+    ``skew`` is the hottest key's run length over the mean run length —
+    the factor by which that key's pair expansion exceeds a uniform
+    key's.  Derived entirely from values the build already synced
+    (``n_valid`` and ``max_run``), so reading it costs nothing and is
+    capture/replay consistent."""
+    if ix.kind != "dense" or ix.max_run <= 0 or ix.n_valid <= 0:
+        return None
+    n_keys = max(1, ix.span)
+    mean_run = ix.n_valid / n_keys
+    return {"max_run": ix.max_run,
+            "n_valid": ix.n_valid,
+            "span": ix.span,
+            "skew": ix.max_run / max(mean_run, 1.0)}
 
 
 # --- multi-column key packing ------------------------------------------------

@@ -57,7 +57,8 @@ except AttributeError:                  # 0.4.x keeps it in experimental
 from ..ops.hashing import murmur3_32, hash_partition
 from ..rowconv.convert import (_to_rows_fixed_words, _from_rows_fixed_words)
 from ..rowconv.layout import compute_row_layout
-from .shuffle import bucketize_rows, all_to_all_shuffle, received_mask
+from .shuffle import (bucketize_rows, all_to_all_shuffle, received_mask,
+                      replicated_partition_ids, salted_partition_ids)
 
 
 class JoinAggSpec(NamedTuple):
@@ -89,6 +90,15 @@ class JoinAggSpec(NamedTuple):
     # never match (tuple-null semantics, same as ops/join_plan.py).
     key_mins: tuple = ()
     key_spans: tuple = ()
+    # AQE skew split (plan.aqe.skew_split): salt ``S`` must be a power of
+    # two dividing the partition count P.  The partition space becomes
+    # ``G = P // S`` key groups × S sub-partitions: fact rows of a key
+    # round-robin over their group's S destinations while every build row
+    # is replicated to all S of them, so each (fact, build) pair still
+    # meets exactly once and the psum merge stays bit-identical to
+    # salt == 1.  Build capacity is per-GROUP need (replicas are one row
+    # per destination each).  1 (the default) is plain hash routing.
+    salt: int = 1
 
 
 def _composite_lane(datas, validm, idxs, mins, spans):
@@ -118,14 +128,14 @@ def _key_lane(spec: JoinAggSpec, key_idx, datas, validm, mask):
     return datas[key_idx], mask & validm[:, key_idx]
 
 
-def _shuffle_side(layout, datas, valid, key, axis_name, capacity, P):
-    """Local columns → JCUDF words → hash-bucketize → all-to-all → decode.
+def _shuffle_side(layout, datas, valid, part, axis_name, capacity, P):
+    """Local columns → JCUDF words → bucketize by precomputed partition
+    ids → all-to-all → decode.
 
     Returns (datas, validity matrix, live-row mask, dropped count) for the
     rows this chip RECEIVED."""
     W = layout.fixed_row_size // 4
     rows = _to_rows_fixed_words(layout, datas, valid).reshape(-1, W)
-    part = hash_partition(murmur3_32(key), P)
     buckets = bucketize_rows(rows, part, P, capacity)
     recv = all_to_all_shuffle(buckets, axis_name)
     mask = received_mask(recv).reshape(-1)
@@ -140,16 +150,26 @@ def _local_join_agg(spec: JoinAggSpec, axis_name, num_partitions,
 
     # shuffle routing hashes the same lane the local probe uses — for
     # composite keys both sides pack with the SAME static windows, so all
-    # rows of a tuple land on one chip
+    # rows of a tuple land on one chip (one SUB-partition of its group
+    # when salted — matching build replicas follow)
     fshuf, _ = _key_lane(spec, spec.fact_key_idx, fact_datas, fact_valid,
                          jnp.bool_(True))
+    if spec.salt > 1:
+        # skew split: replicate the build shard S× (replica-major) so each
+        # sub-partition of a key group holds a full copy of the group's
+        # build rows; fact rows round-robin over the S sub-partitions
+        S = spec.salt
+        build_datas = tuple(jnp.tile(d, S) for d in build_datas)
+        build_valid = jnp.tile(build_valid, (S, 1))
     bshuf, _ = _key_lane(spec, spec.build_key_idx, build_datas, build_valid,
                          jnp.bool_(True))
+    fpart = salted_partition_ids(fshuf, num_partitions, spec.salt)
+    bpart = replicated_partition_ids(bshuf, num_partitions, spec.salt)
     fdatas, fvalidm, fmask, fdrop = _shuffle_side(
-        lf, fact_datas, fact_valid, fshuf,
+        lf, fact_datas, fact_valid, fpart,
         axis_name, spec.fact_capacity, num_partitions)
     bdatas, bvalidm, bmask, bdrop = _shuffle_side(
-        lb, build_datas, build_valid, bshuf,
+        lb, build_datas, build_valid, bpart,
         axis_name, spec.build_capacity, num_partitions)
 
     fkey, flive = _key_lane(spec, spec.fact_key_idx, fdatas, fvalidm, fmask)
@@ -271,25 +291,68 @@ def repartition_join_agg(mesh: jax.sharding.Mesh, spec: JoinAggSpec,
     return fn(tuple(fact_datas), fact_valid, tuple(build_datas), build_valid)
 
 
-def _local_bucket_need(axis_name, num_partitions, fact_key, build_key):
+def _local_bucket_need(axis_name, num_partitions, salt, fact_key, build_key):
     """Per-chip count pass: the largest per-destination bucket each side
-    needs anywhere on the mesh (replicated scalars)."""
-    needs = []
-    for key in (fact_key, build_key):
-        part = hash_partition(murmur3_32(key), num_partitions)
-        counts = jnp.zeros(num_partitions, jnp.int32).at[part].add(
-            1, mode="drop")
-        needs.append(jax.lax.pmax(jnp.max(counts), axis_name))
-    return needs[0], needs[1]
+    needs anywhere on the mesh (replicated scalars).
+
+    With ``salt > 1`` the fact side counts against its salted destinations
+    and the build side against its ``G = P // S`` key groups — replica
+    ``j`` of group ``g`` sends the group's full row count to destination
+    ``g·S + j``, so per-group need IS per-destination need."""
+    fpart = salted_partition_ids(fact_key, num_partitions, salt)
+    fcounts = jnp.zeros(num_partitions, jnp.int32).at[fpart].add(
+        1, mode="drop")
+    need_f = jax.lax.pmax(jnp.max(fcounts), axis_name)
+    groups = num_partitions // salt if salt > 1 else num_partitions
+    bpart = hash_partition(murmur3_32(build_key), groups)
+    bcounts = jnp.zeros(groups, jnp.int32).at[bpart].add(1, mode="drop")
+    need_b = jax.lax.pmax(jnp.max(bcounts), axis_name)
+    return need_f, need_b
 
 
 @lru_cache(maxsize=16)
-def _compiled_bucket_need(mesh, axis_name):
+def _compiled_bucket_need(mesh, axis_name, salt=1):
     P = jax.sharding.PartitionSpec
     axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
     num_partitions = int(np.prod([mesh.shape[a] for a in axes]))
     fn = _shard_map(
-        partial(_local_bucket_need, axis_name, num_partitions),
+        partial(_local_bucket_need, axis_name, num_partitions, salt),
+        mesh=mesh, in_specs=(P(axis_name), P(axis_name)),
+        out_specs=(P(), P()))
+    return jax.jit(fn)
+
+
+def _local_bucket_need_multi(axis_name, num_partitions, salts,
+                             fact_key, build_key):
+    """One-pass count sweep over every candidate salt: the murmur hash is
+    computed once per side and each salt's destinations are one extra
+    scatter — so the AQE path picks its salt from a SINGLE sync instead
+    of measure → decide → re-measure."""
+    fh = murmur3_32(fact_key)
+    bh = murmur3_32(build_key)
+    n = fact_key.shape[0]
+    sub = jnp.arange(n, dtype=jnp.int32)
+    needs_f, needs_b = [], []
+    for S in salts:
+        groups = num_partitions // S
+        fpart = (hash_partition(fh, groups) * S + sub % jnp.int32(S)
+                 if S > 1 else hash_partition(fh, num_partitions))
+        fcounts = jnp.zeros(num_partitions, jnp.int32).at[fpart].add(
+            1, mode="drop")
+        needs_f.append(jax.lax.pmax(jnp.max(fcounts), axis_name))
+        bcounts = jnp.zeros(groups, jnp.int32).at[
+            hash_partition(bh, groups)].add(1, mode="drop")
+        needs_b.append(jax.lax.pmax(jnp.max(bcounts), axis_name))
+    return jnp.stack(needs_f), jnp.stack(needs_b)
+
+
+@lru_cache(maxsize=16)
+def _compiled_bucket_need_multi(mesh, axis_name, salts):
+    P = jax.sharding.PartitionSpec
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    num_partitions = int(np.prod([mesh.shape[a] for a in axes]))
+    fn = _shard_map(
+        partial(_local_bucket_need_multi, axis_name, num_partitions, salts),
         mesh=mesh, in_specs=(P(axis_name), P(axis_name)),
         out_specs=(P(), P()))
     return jax.jit(fn)
@@ -315,7 +378,8 @@ def repartition_join_agg_auto(mesh: jax.sharding.Mesh,
                               fact_valid: jnp.ndarray,
                               build_datas: Sequence[jnp.ndarray],
                               build_valid: jnp.ndarray,
-                              axis_name: str = "data"):
+                              axis_name: str = "data",
+                              salt: "int | None" = None):
     """:func:`repartition_join_agg` with automatic two-phase capacity
     sizing: a count pass measures the true per-destination bucket maxima
     (one tiny sync), capacities are bucketed for compile-cache reuse, and
@@ -332,8 +396,16 @@ def repartition_join_agg_auto(mesh: jax.sharding.Mesh,
     (``ops/join_plan.py`` heuristic: span ≤ max(2·n, 4096), capped), sets
     ``key_min``/``key_span`` so every shard probes by direct lookup.
     ``key_min`` is floored and the span bucketed so nearby datasets share a
-    compile-cache entry."""
+    compile-cache entry.
+
+    ``salt`` forces a skew-split factor (power of two dividing the
+    partition count; see :class:`JoinAggSpec`).  The default ``None``
+    auto-detects: with ``SRJT_AQE`` on, a measured hot-bucket need ≥
+    ``SRJT_AQE_SKEW_FACTOR`` × the uniform expectation triggers a salted
+    sub-join (``plan.aqe.skew_split.fired``) — bit-identical results,
+    hot-side capacity (and padded probe work) cut ~salt×."""
     from ..ops import join_plan
+    from ..utils import knobs, metrics
 
     fki = tuple(fact_key_idx) \
         if isinstance(fact_key_idx, (list, tuple)) else fact_key_idx
@@ -398,9 +470,45 @@ def repartition_join_agg_auto(mesh: jax.sharding.Mesh,
     else:
         fact_key_arr = fact_datas[fki]
         build_key_arr = build_datas[bki]
-    need_fn = _compiled_bucket_need(mesh, axis_name)
-    nf, nb = need_fn(fact_key_arr, build_key_arr)
-    needs = np.asarray(jnp.stack([nf, nb]))      # ONE host sync, two scalars
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    P = int(np.prod([mesh.shape[a] for a in axes]))
+    S = 1 if salt is None else max(int(salt), 1)
+    if S > 1 and ((S & (S - 1)) or P % S):
+        raise ValueError("salt must be a power of two dividing the "
+                         "partition count")
+    if salt is None and P > 1 and knobs.get("SRJT_AQE"):
+        # AQE skew split: a hot key melts one destination bucket; when the
+        # measured need beats the uniform expectation by
+        # SRJT_AQE_SKEW_FACTOR, re-route through salted sub-partitions —
+        # the hot side's capacity (and padded probe work) drops ~S×.  The
+        # multi-salt count sweep measures every candidate in ONE sync, so
+        # choosing a salt costs no extra round trip.
+        cand = [1]
+        while cand[-1] * 2 <= P and P % (cand[-1] * 2) == 0:
+            cand.append(cand[-1] * 2)
+        need_fn = _compiled_bucket_need_multi(mesh, axis_name, tuple(cand))
+        nf, nb = need_fn(fact_key_arr, build_key_arr)
+        needs_all = np.asarray(jnp.stack([nf, nb]))  # ONE sync, [2, k]
+        n_local = max(fact_datas[0].shape[0] // P, 1)
+        uniform = max(n_local / P, 1.0)
+        ratio = float(needs_all[0, 0]) / uniform
+        pick = 0
+        if ratio >= float(knobs.get("SRJT_AQE_SKEW_FACTOR")):
+            # hot-destination need falls as hot_mass/S, so salt up to the
+            # point the uniform tail would dominate (≈ 2·ratio): the
+            # measured multi-salt needs size the buckets either way
+            while pick + 1 < len(cand) and cand[pick + 1] <= 2 * ratio:
+                pick += 1
+        S = cand[pick]
+        needs = needs_all[:, pick]
+        if S > 1 and metrics.recording():
+            metrics.count("plan.aqe.skew_split.fired")
+            metrics.gauge_max("shuffle.salt", S)
+            metrics.annotate(skew_salt=S, skew_ratio=round(ratio, 2))
+    else:
+        need_fn = _compiled_bucket_need(mesh, axis_name, S)
+        nf, nb = need_fn(fact_key_arr, build_key_arr)
+        needs = np.asarray(jnp.stack([nf, nb]))  # ONE host sync, two scalars
     if not multi:
         bk = build_datas[bki]
         bdt = np.dtype(bk.dtype)
@@ -427,12 +535,16 @@ def repartition_join_agg_auto(mesh: jax.sharding.Mesh,
         fact_capacity=_bucket_capacity(needs[0]),
         build_capacity=_bucket_capacity(needs[1]),
         key_min=key_min, key_span=key_span,
-        key_mins=key_mins, key_spans=key_spans)
+        key_mins=key_mins, key_spans=key_spans, salt=S)
+    if metrics.recording():
+        # mesh-wide padded probe slots — the wasted-work proxy the AQE
+        # bench compares static vs salted runs on
+        metrics.count("shuffle.padded_slots.fact", P * P * spec.fact_capacity)
+        metrics.count("shuffle.padded_slots.build",
+                      P * P * spec.build_capacity)
     # arena admission for the exchange's padded bucket buffers (both
     # sides), sized from the measured capacities before dispatch
     from .shuffle import bucket_reservation
-    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
-    P = int(np.prod([mesh.shape[a] for a in axes]))
     row_bytes = [sum(np.dtype(a.dtype).itemsize for a in datas) + len(datas)
                  for datas in (fact_datas, build_datas)]
     with bucket_reservation(P, spec.fact_capacity, row_bytes[0],

@@ -71,6 +71,46 @@ def bucketize_rows(rows: jnp.ndarray, part_id: jnp.ndarray,
     return Buckets(buckets, clipped, dropped)
 
 
+def salted_partition_ids(key: jnp.ndarray, num_partitions: int,
+                         salt: int) -> jnp.ndarray:
+    """Probe (fact) side destination under salt-``S`` sub-partitioning.
+
+    The partition space splits into ``G = P // S`` key groups × ``S``
+    sub-partitions: a key hashes to group ``g`` and each of its rows
+    round-robins (by local row index) over the group's ``S`` consecutive
+    destinations ``g·S + j``.  With ``salt == 1`` this is exactly the
+    plain ``hash_partition`` routing.  A skewed hot key thus spreads over
+    ``S`` chips instead of melting one — the AQE skew-split primitive
+    (``plan.aqe.skew_split``)."""
+    from ..ops.hashing import hash_partition, murmur3_32
+    if salt <= 1:
+        return hash_partition(murmur3_32(key), num_partitions)
+    groups = num_partitions // salt
+    g = hash_partition(murmur3_32(key), groups)
+    n = key.shape[0]
+    sub = jnp.arange(n, dtype=jnp.int32) % jnp.int32(salt)
+    return (g.astype(jnp.int32) * salt + sub).astype(jnp.int32)
+
+
+def replicated_partition_ids(key_tiled: jnp.ndarray, num_partitions: int,
+                             salt: int) -> jnp.ndarray:
+    """Build side twin of :func:`salted_partition_ids`: ``key_tiled`` is
+    the build key lane tiled ``S``× (replica-major — ``jnp.tile(key, S)``)
+    and replica ``j`` of a key in group ``g`` routes to destination
+    ``g·S + j``.  Every fact row of the key meets exactly ONE replica of
+    each matching build row (the one in its own sub-partition), so the
+    psum-merged aggregate counts each (fact, build) pair exactly once —
+    salting is bit-identical to the unsalted join."""
+    from ..ops.hashing import hash_partition, murmur3_32
+    if salt <= 1:
+        return hash_partition(murmur3_32(key_tiled), num_partitions)
+    groups = num_partitions // salt
+    n = key_tiled.shape[0] // salt
+    g = hash_partition(murmur3_32(key_tiled), groups)
+    replica = (jnp.arange(salt * n, dtype=jnp.int32) // jnp.int32(max(n, 1)))
+    return (g.astype(jnp.int32) * salt + replica).astype(jnp.int32)
+
+
 def bucket_reservation(num_partitions: int, capacity: int,
                        row_nbytes: int, sides: int = 1, tag: str = "shuffle"):
     """HBM-arena admission context for a sized exchange's padded bucket

@@ -8,7 +8,9 @@ hand-fused op sequence — bit-identical results, composing unchanged with
 capture/replay and the serving runtime.
 """
 
-from . import ir, lower, rules, stats
+from . import adaptive, ir, lower, rules, stats
+from .adaptive import (AdaptiveReport, compile_adaptive_plan,
+                       execute_adaptive, explain_adaptive)
 from .ir import (Aggregate, And, Between, Cmp, Col, Filter,
                  FusedJoinAggregate, IsIn, Join, Limit, Lit, Mul, Or, Plan,
                  PlanError, Project, ScalarAgg, Scan, Sort, Window,
@@ -20,7 +22,9 @@ from .stats import GLOBAL as GLOBAL_STATS
 from .stats import CardinalityStats
 
 __all__ = [
-    "ir", "lower", "rules", "stats",
+    "ir", "lower", "rules", "stats", "adaptive",
+    "AdaptiveReport", "compile_adaptive_plan", "execute_adaptive",
+    "explain_adaptive",
     "Plan", "PlanError", "Scan", "Filter", "Project", "Join", "Aggregate",
     "FusedJoinAggregate", "Window", "Sort", "Limit",
     "Col", "Lit", "Cmp", "Between", "And", "Or", "IsIn", "ScalarAgg", "Mul",

@@ -1,0 +1,445 @@
+"""Adaptive query execution: stage-wise runtime re-optimization.
+
+The static optimizer (``plan/rules.py``) fires once, before execution,
+on whatever priors ``plan/stats.py`` has accumulated.  This module
+closes Spark-AQE's loop instead: the lowered tree executes **stage by
+stage** — a stage boundary at every join / aggregate barrier, exactly
+where intermediate tables materialize — and between stages the
+*observed* runtime facts feed back into the not-yet-executed remainder:
+
+* **replan** — a left-deep inner-join chain ending in a
+  ``FusedJoinAggregate`` re-orders its pending dimension joins on the
+  dimensions' *actual post-filter* row counts (static shapes make those
+  free) instead of ``CardinalityStats`` priors.  Restricted to shapes
+  where the result is provably bit-identical: the aggregated output is
+  sorted by group key (order-insensitive) and every aggregate is exact
+  (non-float inputs, no first/last), so any join order produces the
+  same bytes.
+* **engine_flip** — each join pre-probes the *materialized* build side
+  (valid-key count, key window) plus the probe-side row count and flips
+  the dense↔sorted engine when the observed statistics disagree with
+  the lowering-time heuristic.  Executed through the existing
+  ``ops/join_plan.force_engine`` seam, so every variant stays
+  bit-identical; an ambient force (scheduler degradation,
+  ``SRJT_JOIN_ENGINE``) always wins over an adaptive pin.
+* **skew** — when the dense window is chosen, the same pass computes the
+  build-index CSR histogram's hottest run.  On this local path the
+  signal is advisory (``plan.aqe.skew_split.advisory`` + report detail);
+  the *acting* consumer is the repartition path
+  (``parallel/repartition_join.py``), which salts skewed hot keys into
+  sub-joins when the measured per-partition need exceeds
+  ``SRJT_AQE_SKEW_FACTOR`` × the mean.
+
+Capture/replay discipline — the load-bearing invariant: every adaptive
+decision derives ONLY from (a) intermediate-table ``num_rows`` (static
+Python ints, identical under replay because compaction sizes come from
+the tape) and (b) ``syncs.scalar`` reads (recorded on capture, popped on
+replay).  Capture and replay therefore take the same host branches and
+the tape stays aligned — decisions simply execute inline on every run,
+no decided-plan state machine.  All probe syncs are unconditional on the
+reached path (never gated on metrics state).
+
+Plan-cache composition: ``compile_adaptive_plan`` tags its qfn with
+``aqe_variant``, which ``exec/plan_cache.get_or_compile`` folds into the
+cache key — adaptive and static compiles of the same tree never share
+(or thrash) an entry.
+
+Everything is behind ``SRJT_AQE`` (default off): ``lower.execute`` /
+``lower.compile_plan`` route here only when the knob is on, so the off
+path is byte-for-byte the static executor.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..ops import join_plan
+from ..utils import flight, knobs, metrics, syncs
+from . import ir, lower
+from . import stats as plan_stats
+
+#: observed rows > this factor × the prior estimate, on a stage where a
+#: decision fired → flight-recorder ``aqe_regression`` incident
+REGRESSION_FACTOR = 2.0
+
+#: exact (order-insensitive) aggregate functions over non-float inputs;
+#: first/last are input-order-sensitive by definition and float sums
+#: reassociate, so neither may be reordered across
+_REORDERABLE_AGGS = ("sum", "count", "min", "max", "mean")
+
+
+def enabled() -> bool:
+    return bool(knobs.get("SRJT_AQE"))
+
+
+# --- decision / stage records (the EXPLAIN payload) --------------------------
+
+
+@dataclass(frozen=True)
+class Decision:
+    kind: str            # "replan" | "engine_flip" | "skew_advisory"
+    detail: str
+
+
+@dataclass
+class StageRecord:
+    """One barrier-node stage: what the priors predicted, what actually
+    came out, and which runtime rules fired in between."""
+    index: int
+    node: str                          # EXPLAIN line of the barrier node
+    est_rows: Optional[float] = None   # prior estimate (None = unknown)
+    rows: Optional[int] = None         # observed output rows
+    decisions: list = field(default_factory=list)
+
+
+@dataclass
+class AdaptiveReport:
+    stages: list = field(default_factory=list)
+
+    def decisions(self) -> list:
+        return [d for s in self.stages for d in s.decisions]
+
+    def render(self) -> str:
+        lines = ["== Adaptive execution =="]
+        if not self.stages:
+            lines.append("(no barrier stages)")
+        for s in self.stages:
+            est = "?" if s.est_rows is None else f"{s.est_rows:.0f}"
+            lines.append(f"stage {s.index}: {s.node}")
+            lines.append(f"  est={est} rows → observed={s.rows} rows")
+            for d in s.decisions:
+                lines.append(f"  fired    {d.kind}: {d.detail}")
+        n = len(self.decisions())
+        lines.append(f"({n} adaptive decision(s))")
+        return "\n".join(lines)
+
+
+# --- engine / skew probe -----------------------------------------------------
+
+
+class _Probe(NamedTuple):
+    engine: Optional[str]   # pin to apply ("dense"/"sorted"), None = agree
+    detail: str
+    skew: Optional[dict]    # skew_stats-shaped dict when dense + skewed
+
+
+def _probe_engine(node, kids) -> Optional[_Probe]:
+    """Observed-statistics engine choice for one Join/FusedJoinAggregate,
+    or None when the key shape never qualifies for the dense engine.
+
+    Syncs the build lane's valid count and key window (3 scalars — the
+    same values ``_build_index`` would sync) *before* the join runs, so
+    the index is built directly in the decided kind.  The adaptive rule
+    widens the static span limit by the observed probe-side row count:
+    a dense LUT is worth building whenever the probe side amortizes it,
+    even when the build side alone would not
+    (``span ≤ max(2·n_valid, FLOOR, probe_rows)``, still capped).
+    """
+    (lt, ln), (rt, rn) = kids
+    try:
+        lon = [ln.index(c) for c in node.left_on]
+        ron = [rn.index(c) for c in node.right_on]
+    except ValueError:
+        return None
+    plan = join_plan.plan_keys([lt[i] for i in lon], [rt[i] for i in ron])
+    if plan.mode not in ("single", "composite") or not plan.dense_ok:
+        return None
+    n = int(plan.rdata.shape[0])
+    if n == 0:
+        return None
+    # unconditional scalar syncs (capture/replay tape alignment)
+    if plan.rvalid is None:
+        n_valid = n
+        kmin = syncs.scalar(jnp.min(plan.rdata))
+        kmax = syncs.scalar(jnp.max(plan.rdata))
+    else:
+        info = np.iinfo(np.dtype(plan.rdata.dtype))
+        n_valid = syncs.scalar(jnp.sum(plan.rvalid))
+        kmin = syncs.scalar(jnp.min(jnp.where(plan.rvalid, plan.rdata,
+                                              info.max)))
+        kmax = syncs.scalar(jnp.max(jnp.where(plan.rvalid, plan.rdata,
+                                              info.min)))
+    if n_valid == 0:
+        return None
+    span = kmax - kmin + 1
+    probe_rows = int(plan.ldata.shape[0])
+    floor = max(join_plan.DENSE_SPAN_FACTOR * n_valid,
+                join_plan.DENSE_SPAN_FLOOR)
+    static_dense = span <= min(floor, join_plan.DENSE_SPAN_CAP)
+    adaptive_dense = span <= min(max(floor, probe_rows),
+                                 join_plan.DENSE_SPAN_CAP)
+
+    skew = None
+    if adaptive_dense:
+        # dense window decided: the CSR histogram is one scatter-add away
+        # — compute the hottest run (the skew signal) on the spot
+        slot = jnp.clip(plan.rdata.astype(jnp.int64) - kmin, 0,
+                        span - 1).astype(jnp.int32)
+        ok = (jnp.ones(n, jnp.bool_) if plan.rvalid is None
+              else plan.rvalid)
+        cnt = jnp.zeros(span, jnp.int32).at[slot].add(ok.astype(jnp.int32))
+        max_run = syncs.scalar(jnp.max(cnt))
+        mean_run = max(n_valid / max(span, 1), 1.0)
+        ratio = max_run / mean_run
+        if ratio >= knobs.get("SRJT_AQE_SKEW_FACTOR"):
+            skew = {"max_run": max_run, "n_valid": n_valid,
+                    "span": span, "skew": ratio}
+
+    if adaptive_dense == static_dense:
+        return _Probe(None, "", skew)
+    eng = "dense" if adaptive_dense else "sorted"
+    detail = (f"{'sorted' if adaptive_dense else 'dense'}→{eng} "
+              f"(span={span}, n_valid={n_valid}, probe_rows={probe_rows})")
+    return _Probe(eng, detail, skew)
+
+
+# --- reorderable chain detection ---------------------------------------------
+
+
+class _ChainDim(NamedTuple):
+    plan: ir.Plan
+    left_on: tuple
+    right_on: tuple
+
+
+def _collect_chain(fja: ir.FusedJoinAggregate):
+    """``(base, dims)`` for a left-deep inner-join spine under an inner
+    FusedJoinAggregate, or None.  ``dims[i]`` carries the key pair that
+    binds dimension *i*; the FJA's own join is the last element.  Needs
+    at least two dims for a reorder to exist."""
+    if fja.how != "inner":
+        return None
+    spine = []
+    node = fja.left
+    while isinstance(node, ir.Join) and node.how == "inner":
+        spine.append(node)
+        node = node.left
+    if not spine:
+        return None
+    base = node
+    dims = [_ChainDim(j.right, j.left_on, j.right_on)
+            for j in reversed(spine)]
+    dims.append(_ChainDim(fja.right, fja.left_on, fja.right_on))
+    return base, dims
+
+
+def _aggs_order_insensitive(fja, results) -> bool:
+    """True when every aggregate of ``fja`` produces identical bytes
+    under any join order: exact fn over a non-float input column.
+    ``results`` holds the executed (table, names) of base + dims."""
+    for c, fn, _out in fja.aggs:
+        if fn not in _REORDERABLE_AGGS:
+            return False
+        col = None
+        for t, names in results:
+            if c in names:
+                col = t[names.index(c)]
+                break
+        if col is None:
+            return False
+        dt = col.dtype
+        if dt.is_variable_width or dt.is_nested:
+            return False
+        if dt.id in (T.TypeId.FLOAT32, T.TypeId.FLOAT64,
+                     T.TypeId.DECIMAL128):
+            return False
+    return True
+
+
+# --- stage-wise executor -----------------------------------------------------
+
+
+_BARRIERS = (ir.Join, ir.FusedJoinAggregate, ir.Aggregate)
+
+
+class _Exec:
+    def __init__(self, catalog, record_stats: bool,
+                 report: AdaptiveReport):
+        self.catalog = catalog
+        self.record_stats = record_stats
+        self.report = report
+
+    # . generic recursion .....................................................
+
+    def run(self, node: ir.Plan):
+        if isinstance(node, ir.FusedJoinAggregate):
+            chain = _collect_chain(node)
+            if chain is not None and len(chain[1]) >= 2:
+                return self._run_chain(node, *chain)
+        kids = [self.run(k) for k in ir.children(node)]
+        return self._apply(node, kids)
+
+    # . one barrier stage .....................................................
+
+    def _apply(self, node: ir.Plan, kids,
+               extra_decisions: Optional[list] = None):
+        if not isinstance(node, _BARRIERS):
+            return lower._apply_node(node, kids, self.catalog,
+                                     self.record_stats)
+        stage = StageRecord(index=len(self.report.stages),
+                            node=ir._node_line(node),
+                            est_rows=plan_stats.GLOBAL.rows_for(node))
+        if extra_decisions:
+            stage.decisions.extend(extra_decisions)
+        self.report.stages.append(stage)
+
+        force = None
+        if (isinstance(node, (ir.Join, ir.FusedJoinAggregate))
+                and node.engine is None
+                and join_plan.forced_engine() is None):
+            probe = _probe_engine(node, kids)
+            if probe is not None:
+                if probe.engine is not None:
+                    force = probe.engine
+                    stage.decisions.append(
+                        Decision("engine_flip", probe.detail))
+                    if metrics.recording():
+                        metrics.count("plan.aqe.engine_flip.fired")
+                        metrics.count(
+                            f"plan.aqe.engine_flip.{probe.engine}")
+                if probe.skew is not None:
+                    s = probe.skew
+                    stage.decisions.append(Decision(
+                        "skew_advisory",
+                        f"hot key ×{s['skew']:.1f} mean "
+                        f"(max_run={s['max_run']}, "
+                        f"n_valid={s['n_valid']})"))
+                    if metrics.recording():
+                        metrics.count("plan.aqe.skew_split.advisory")
+                        metrics.gauge_max("plan.aqe.skew_split.max_run",
+                                          s["max_run"])
+
+        if force is None:
+            t, names = lower._apply_node(node, kids, self.catalog,
+                                         self.record_stats)
+        else:
+            # the same force_engine seam the scheduler's degradation
+            # uses — stats still observe the UNPINNED fingerprint, so
+            # static-optimizer priors and adaptive observations share
+            # one keyspace
+            with join_plan.force_engine(force):
+                t, names = lower._apply_node(node, kids, self.catalog,
+                                             self.record_stats)
+        stage.rows = t.num_rows
+        self._check_regression(stage)
+        return t, names
+
+    def _check_regression(self, stage: StageRecord) -> None:
+        if (not stage.decisions or stage.est_rows is None
+                or stage.rows is None or stage.est_rows <= 0):
+            return
+        if stage.rows <= REGRESSION_FACTOR * stage.est_rows:
+            return
+        if metrics.recording():
+            metrics.count("plan.aqe.regression")
+        if syncs.mode() == "normal":
+            # replay would re-report capture's incident; snapshot once
+            flight.incident(
+                "aqe_regression", stage=stage.index, node=stage.node,
+                est_rows=stage.est_rows, observed_rows=stage.rows,
+                decisions=[f"{d.kind}: {d.detail}"
+                           for d in stage.decisions])
+
+    # . chain replanning ......................................................
+
+    def _run_chain(self, fja: ir.FusedJoinAggregate, base_node, dims):
+        base = self.run(base_node)
+        dim_res = [self.run(d.plan) for d in dims]
+
+        order = list(range(len(dims)))
+        decisions: list = []
+        base_names = set(base[1])
+        commutable = all(set(d.left_on) <= base_names for d in dims)
+        exact = commutable and _aggs_order_insensitive(
+            fja, [base] + dim_res)
+        rows = [r[0].num_rows for r in dim_res]
+        min_rows = knobs.get("SRJT_AQE_REPLAN_MIN_ROWS")
+        if exact and max(rows) >= min_rows:
+            picked = sorted(order, key=lambda i: (rows[i], i))
+            if picked != order:
+                before = [rows[i] for i in order]
+                after = [rows[i] for i in picked]
+                decisions.append(Decision(
+                    "replan",
+                    f"join order {order} → {picked} "
+                    f"(observed dim rows {before} → {after})"))
+                if metrics.recording():
+                    metrics.count("plan.aqe.replan.fired")
+                order = picked
+        elif metrics.recording():
+            metrics.count("plan.aqe.replan.rejected")
+
+        # rebuild the spine in the chosen order; synthesized nodes are
+        # value-equal to the originals when the order is unchanged, so
+        # fingerprints, stats, and the op sequence match the static
+        # executor exactly
+        cur_plan, cur_res = base_node, base
+        for j in order[:-1]:
+            d = dims[j]
+            jn = ir.Join(cur_plan, d.plan, d.left_on, d.right_on, "inner")
+            cur_res = self._apply(jn, [cur_res, dim_res[j]],
+                                  extra_decisions=decisions)
+            decisions = []          # attach replan to the first stage only
+            cur_plan = jn
+        last = dims[order[-1]]
+        fnode = ir.FusedJoinAggregate(
+            cur_plan, last.plan, last.left_on, last.right_on,
+            fja.keys, fja.aggs, fja.how)
+        return self._apply(fnode, [cur_res, dim_res[order[-1]]],
+                           extra_decisions=decisions)
+
+
+# --- entry points ------------------------------------------------------------
+
+
+def execute_adaptive(tree: ir.Plan, catalog, record_stats: bool = True,
+                     report: Optional[AdaptiveReport] = None):
+    """Run a plan tree with stage-wise adaptive re-optimization.  Returns
+    the result Table; pass ``report`` to collect the decision log."""
+    plan_stats.ensure_sidecar_loaded()
+    if report is None:
+        report = AdaptiveReport()
+    with metrics.span("plan.adaptive"):
+        t, _names = _Exec(catalog, record_stats, report).run(tree)
+    if metrics.recording():
+        metrics.annotate(aqe_decisions=len(report.decisions()))
+    return t
+
+
+def compile_adaptive_plan(tree: ir.Plan, schemas: dict):
+    """Adaptive twin of ``lower.compile_plan``: same qfn shape, plus an
+    ``aqe_variant`` tag the exec plan cache folds into its key and a
+    ``last_report`` attribute holding the most recent decision log."""
+    ir.schema_of(tree, schemas)
+
+    def qfn(tables):
+        report = AdaptiveReport()
+        t = execute_adaptive(tree, lower.TableCatalog(tables, schemas),
+                             report=report)
+        qfn.last_report = report
+        return t
+
+    qfn.plan_tree = tree
+    qfn.plan_fingerprint = ir.fingerprint(tree)
+    qfn.aqe_variant = "aqe"
+    qfn.last_report = None
+    return qfn
+
+
+def explain_adaptive(tree: ir.Plan, schemas: dict, tables: dict,
+                     stats=None) -> str:
+    """EXPLAIN with the adaptive appendix: optimizes ``tree``, executes
+    the optimized tree adaptively against ``tables``, and renders the
+    static report plus the stage-wise decisions that actually fired."""
+    from . import rules
+    res = rules.optimize(tree, schemas, stats=stats)
+    report = AdaptiveReport()
+    execute_adaptive(res.tree, lower.TableCatalog(tables, schemas),
+                     record_stats=False, report=report)
+    return rules.explain(tree, schemas, stats=stats,
+                         adaptive_report=report)

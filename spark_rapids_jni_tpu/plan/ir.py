@@ -197,12 +197,21 @@ class Project(Plan):
 
 @dataclass(frozen=True)
 class Join(Plan):
-    """Equi-join; output schema = left schema ++ right schema."""
+    """Equi-join; output schema = left schema ++ right schema.
+
+    ``engine`` optionally pins the physical build-index engine
+    (``"dense"`` / ``"sorted"``) for THIS join — the adaptive executor
+    (``plan/adaptive.py``) bakes observed-statistics engine flips into
+    the tree through it.  ``None`` (the default, and the only value the
+    front-end emits) keeps the ``ops/join_plan.py`` heuristic; both
+    engines produce bit-identical results, so a pin only trades
+    footprint for speed."""
     left: Plan
     right: Plan
     left_on: Tuple[str, ...]
     right_on: Tuple[str, ...]
     how: str = "inner"
+    engine: Optional[str] = None
 
     def __post_init__(self):
         object.__setattr__(self, "left_on", tuple(self.left_on))
@@ -236,6 +245,7 @@ class FusedJoinAggregate(Plan):
     keys: Tuple[str, ...]
     aggs: Tuple[Tuple[str, str, str], ...]
     how: str = "inner"
+    engine: Optional[str] = None     # see Join.engine
 
     def __post_init__(self):
         object.__setattr__(self, "left_on", tuple(self.left_on))
@@ -436,8 +446,12 @@ def _sexp(node: Plan) -> str:
     if isinstance(node, Join):
         keys = ",".join(f"{l}={r}"
                         for l, r in zip(node.left_on, node.right_on))
+        # engine pin participates only when SET: unpinned trees (every
+        # tree the front-end builds) keep their historical fingerprints,
+        # while adaptive-decided trees get distinct cache keys for free
+        eng = "" if node.engine is None else f",e={node.engine}"
         return (f"join({node.how},{_sexp(node.left)},{_sexp(node.right)},"
-                f"[{keys}])")
+                f"[{keys}]{eng})")
     if isinstance(node, Aggregate):
         aggs = ",".join(f"{fn}({c})>{o}" for c, fn, o in node.aggs)
         return (f"agg({_sexp(node.child)},[{','.join(node.keys)}],"
@@ -446,9 +460,10 @@ def _sexp(node: Plan) -> str:
         keys = ",".join(f"{l}={r}"
                         for l, r in zip(node.left_on, node.right_on))
         aggs = ",".join(f"{fn}({c})>{o}" for c, fn, o in node.aggs)
+        eng = "" if node.engine is None else f",e={node.engine}"
         return (f"joinagg({node.how},{_sexp(node.left)},"
                 f"{_sexp(node.right)},[{keys}],[{','.join(node.keys)}],"
-                f"[{aggs}])")
+                f"[{aggs}]{eng})")
     if isinstance(node, Window):
         return (f"window({_sexp(node.child)},{node.fn},"
                 f"[{','.join(node.partition_by)}],"
@@ -514,7 +529,8 @@ def _node_line(node: Plan) -> str:
     if isinstance(node, Join):
         keys = ", ".join(f"{l} = {r}"
                          for l, r in zip(node.left_on, node.right_on))
-        return f"Join {node.how} on ({keys})"
+        eng = "" if node.engine is None else f" engine={node.engine}"
+        return f"Join {node.how} on ({keys}){eng}"
     if isinstance(node, Aggregate):
         aggs = ", ".join(f"{fn}({c}) AS {o}" for c, fn, o in node.aggs)
         return f"Aggregate keys=[{', '.join(node.keys)}] aggs=[{aggs}]"
@@ -522,8 +538,9 @@ def _node_line(node: Plan) -> str:
         keys = ", ".join(f"{l} = {r}"
                          for l, r in zip(node.left_on, node.right_on))
         aggs = ", ".join(f"{fn}({c}) AS {o}" for c, fn, o in node.aggs)
+        eng = "" if node.engine is None else f" engine={node.engine}"
         return (f"FusedJoinAggregate {node.how} on ({keys}) "
-                f"keys=[{', '.join(node.keys)}] aggs=[{aggs}]")
+                f"keys=[{', '.join(node.keys)}] aggs=[{aggs}]{eng}")
     if isinstance(node, Window):
         return (f"Window {node.fn} partition=[{', '.join(node.partition_by)}]"
                 f" order=[{', '.join(node.order_by)}] AS {node.out}")

@@ -26,6 +26,7 @@ natural request name.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Optional
 
 import numpy as np
@@ -295,7 +296,32 @@ def _on_arg(idxs: list[int]):
     return idxs[0] if len(idxs) == 1 else idxs
 
 
-def _execute(node: ir.Plan, catalog, record_stats: bool):
+@contextlib.contextmanager
+def _engine_pin(node: ir.Plan):
+    """Honor an adaptive engine pin (``Join.engine`` /
+    ``FusedJoinAggregate.engine``) around one join's execution.  An
+    ambient force — the scheduler's degraded-admission
+    ``force_engine("sorted")`` or the ``SRJT_JOIN_ENGINE`` knob — always
+    wins: a pin decided from observed statistics must not override a
+    footprint-driven degradation."""
+    from ..ops import join_plan
+    eng = getattr(node, "engine", None)
+    if eng is None or join_plan.forced_engine() is not None:
+        yield
+        return
+    with join_plan.force_engine(eng):
+        yield
+
+
+def _apply_node(node: ir.Plan, kids: list, catalog, record_stats: bool):
+    """Apply ONE plan node to its already-computed child results.
+
+    ``kids`` holds one ``(table, names)`` pair per ``ir.children(node)``
+    entry.  This is the single place a node becomes op calls —
+    :func:`_execute` (the static recursive executor) and
+    ``plan/adaptive.py`` (the stage-wise adaptive executor) both route
+    through it, so an adaptively re-ordered plan runs the exact op
+    sequence the static lowering of the same tree would."""
     t: Table
     names: list[str]
     if isinstance(node, ir.Scan):
@@ -312,40 +338,40 @@ def _execute(node: ir.Plan, catalog, record_stats: bool):
                 t = apply_boolean_mask(t, eval_mask(node.predicate, t,
                                                     names))
     elif isinstance(node, ir.Filter):
-        t, names = _execute(node.child, catalog, record_stats)
+        t, names = kids[0]
         t = apply_boolean_mask(t, eval_mask(node.predicate, t, names))
     elif isinstance(node, ir.Project):
-        ct, cnames = _execute(node.child, catalog, record_stats)
+        ct, cnames = kids[0]
         t = Table([ct[cnames.index(c)] for c in node.columns])
         names = list(node.columns)
     elif isinstance(node, ir.Join):
-        lt, ln = _execute(node.left, catalog, record_stats)
-        rt, rn = _execute(node.right, catalog, record_stats)
+        (lt, ln), (rt, rn) = kids
         fn = {"inner": inner_join, "left": left_join}.get(node.how)
         if fn is None:
             raise ir.PlanError(f"unsupported join type {node.how!r}")
-        t = fn(lt, rt, _on_arg(_key_indices(ln, node.left_on)),
-               _on_arg(_key_indices(rn, node.right_on)))
+        with _engine_pin(node):
+            t = fn(lt, rt, _on_arg(_key_indices(ln, node.left_on)),
+                   _on_arg(_key_indices(rn, node.right_on)))
         names = ln + rn
     elif isinstance(node, ir.FusedJoinAggregate):
-        lt, ln = _execute(node.left, catalog, record_stats)
-        rt, rn = _execute(node.right, catalog, record_stats)
+        (lt, ln), (rt, rn) = kids
         joined = ln + rn
-        t = join_aggregate(
-            lt, rt, _on_arg(_key_indices(ln, node.left_on)),
-            _on_arg(_key_indices(rn, node.right_on)),
-            _key_indices(joined, node.keys),
-            [(joined.index(c), fn) for c, fn, _out in node.aggs],
-            how=node.how)
+        with _engine_pin(node):
+            t = join_aggregate(
+                lt, rt, _on_arg(_key_indices(ln, node.left_on)),
+                _on_arg(_key_indices(rn, node.right_on)),
+                _key_indices(joined, node.keys),
+                [(joined.index(c), fn) for c, fn, _out in node.aggs],
+                how=node.how)
         names = list(node.keys) + [a[2] for a in node.aggs]
     elif isinstance(node, ir.Aggregate):
-        ct, cnames = _execute(node.child, catalog, record_stats)
+        ct, cnames = kids[0]
         t = groupby_aggregate(
             ct, _key_indices(cnames, node.keys),
             [(cnames.index(c), fn) for c, fn, _out in node.aggs])
         names = list(node.keys) + [a[2] for a in node.aggs]
     elif isinstance(node, ir.Window):
-        ct, cnames = _execute(node.child, catalog, record_stats)
+        ct, cnames = kids[0]
         spec = W.WindowSpec(ct, _key_indices(cnames, node.partition_by),
                             _key_indices(cnames, node.order_by))
         order_idx = _key_indices(cnames, node.order_by)
@@ -360,12 +386,12 @@ def _execute(node: ir.Plan, catalog, record_stats: bool):
         t = Table(list(ct.columns) + [wcol])
         names = cnames + [node.out]
     elif isinstance(node, ir.Sort):
-        ct, cnames = _execute(node.child, catalog, record_stats)
+        ct, cnames = kids[0]
         asc = None if node.ascending is None else list(node.ascending)
         t = sort_table(ct, _key_indices(cnames, node.keys), ascending=asc)
         names = cnames
     elif isinstance(node, ir.Limit):
-        ct, cnames = _execute(node.child, catalog, record_stats)
+        ct, cnames = kids[0]
         t = slice_table(ct, 0, node.n)
         names = cnames
     else:
@@ -378,8 +404,22 @@ def _execute(node: ir.Plan, catalog, record_stats: bool):
     return t, names
 
 
+def _execute(node: ir.Plan, catalog, record_stats: bool):
+    kids = [_execute(k, catalog, record_stats)
+            for k in ir.children(node)]
+    return _apply_node(node, kids, catalog, record_stats)
+
+
 def execute(tree: ir.Plan, catalog, record_stats: bool = True) -> Table:
-    """Run a (typically optimized) plan tree against a catalog."""
+    """Run a (typically optimized) plan tree against a catalog.  With
+    ``SRJT_AQE`` on, routes through the stage-wise adaptive executor
+    (``plan/adaptive.py``); off (the default) is the static path,
+    byte-for-byte."""
+    from ..utils import knobs
+    if knobs.get("SRJT_AQE"):
+        from . import adaptive
+        return adaptive.execute_adaptive(tree, catalog,
+                                         record_stats=record_stats)
     t, _names = _execute(tree, catalog, record_stats)
     return t
 
@@ -392,11 +432,23 @@ def compile_plan(tree: ir.Plan, schemas: dict):
     """Wrap a plan tree as ``qfn(tables: dict[str, Table]) -> Table`` —
     the exact callable shape ``models/compiled.compile_query``, the
     ``exec/`` plan cache, and the scheduler consume.  Use
-    ``ir.fingerprint(tree)`` as the request/cache name."""
+    ``ir.fingerprint(tree)`` as the request/cache name.
+
+    With ``SRJT_AQE`` on at build time, returns the adaptive twin
+    (``plan/adaptive.compile_adaptive_plan``), tagged ``aqe_variant`` so
+    the exec plan cache keys it separately.  Either way the returned qfn
+    is PINNED to the mode it was built under — a compiled (and possibly
+    plan-cached) query must not change execution strategy when the env
+    flips later."""
+    from ..utils import knobs
+    if knobs.get("SRJT_AQE"):
+        from . import adaptive
+        return adaptive.compile_adaptive_plan(tree, schemas)
     ir.schema_of(tree, schemas)       # validate once at build time
 
     def qfn(tables: dict[str, Table]) -> Table:
-        return execute(tree, TableCatalog(tables, schemas))
+        t, _names = _execute(tree, TableCatalog(tables, schemas), True)
+        return t
 
     qfn.plan_tree = tree
     qfn.plan_fingerprint = ir.fingerprint(tree)

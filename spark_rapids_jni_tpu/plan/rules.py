@@ -321,6 +321,11 @@ def optimize(tree: ir.Plan, schemas: dict, stats=None,
     """
     if not knobs.get("SRJT_PLAN_OPT"):
         return OptimizeResult(tree, (), (), 0, True)
+    if stats is not None:
+        # warm priors: merge the SRJT_PLAN_STATS_PATH sidecar (once per
+        # process) before any rule consults cardinalities
+        from . import stats as plan_stats
+        plan_stats.ensure_sidecar_loaded()
     active = list(DEFAULT_RULES if rules is None else rules)
     only = knobs.get("SRJT_PLAN_RULES")
     if only:
@@ -362,8 +367,14 @@ def optimize(tree: ir.Plan, schemas: dict, stats=None,
 
 
 def explain(tree: ir.Plan, schemas: dict, stats=None,
-            rules: Optional[Sequence[Rule]] = None) -> str:
-    """Render the pre-/post-rewrite tree with per-rule annotations."""
+            rules: Optional[Sequence[Rule]] = None,
+            adaptive_report=None) -> str:
+    """Render the pre-/post-rewrite tree with per-rule annotations.
+
+    ``adaptive_report`` (a ``plan.adaptive.AdaptiveReport``) appends the
+    stage-wise runtime decisions of an adaptive execution — the static
+    EXPLAIN shows what the optimizer *planned*, the adaptive section what
+    observed cardinalities actually *did*."""
     res = optimize(tree, schemas, stats=stats, rules=rules)
     lines = ["== Logical plan ==", ir.render(tree), "",
              f"== Optimized plan ({res.passes} pass(es)"
@@ -375,4 +386,6 @@ def explain(tree: ir.Plan, schemas: dict, stats=None,
         lines.append(f"fired    {ev.rule}: {ev.detail}")
     for ev in res.rejections:
         lines.append(f"rejected {ev.rule}: {ev.detail}")
+    if adaptive_report is not None:
+        lines += ["", adaptive_report.render()]
     return "\n".join(lines)

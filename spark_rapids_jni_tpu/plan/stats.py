@@ -13,11 +13,20 @@ Two sources, in priority order:
 
 When neither source knows a subtree, ``rows_for`` returns ``None`` and
 the reorder rule rejects (a deliberate no-op: never reorder blind).
+
+With ``SRJT_PLAN_STATS_PATH`` set, the process-wide store additionally
+persists to a JSON sidecar: loaded lazily on first use (a fresh process
+re-optimizes with warm priors instead of cold defaults) and written back
+atomically (tmp + ``os.replace``) at interpreter exit.  A corrupt or
+missing sidecar is silently treated as empty — stats are advisory.
 """
 
 from __future__ import annotations
 
+import atexit
+import json
 import os
+import tempfile
 from collections import OrderedDict
 from typing import Optional
 
@@ -99,7 +108,87 @@ class CardinalityStats:
         with self._lock:
             return len(self._rows)
 
+    # --- JSON sidecar (SRJT_PLAN_STATS_PATH) -----------------------------
+
+    def load_sidecar(self, path: str) -> int:
+        """Merge fingerprint → rows entries from ``path`` (oldest-first,
+        so live observations outrank persisted ones in the LRU).  Returns
+        the number of entries merged; any read/parse failure counts as an
+        empty sidecar."""
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+            entries = doc.get("rows", {})
+            if not isinstance(entries, dict):
+                return 0
+        except (OSError, ValueError):
+            return 0
+        n = 0
+        with self._lock:
+            for fp, rows in entries.items():
+                if not isinstance(fp, str) or fp in self._rows:
+                    continue
+                try:
+                    rows = int(rows)
+                except (TypeError, ValueError):
+                    continue
+                self._rows[fp] = rows
+                self._rows.move_to_end(fp, last=False)
+                n += 1
+            while len(self._rows) > self._max:
+                self._rows.popitem(last=False)
+        if n and metrics.recording():
+            metrics.count("plan.stats.sidecar_loaded", n)
+        return n
+
+    def save_sidecar(self, path: str) -> bool:
+        """Atomically write the store to ``path`` (tmp + ``os.replace``,
+        never a torn file).  Returns False on any OS failure — persistence
+        is best-effort, stats are advisory."""
+        with self._lock:
+            snap = dict(self._rows)
+        doc = {"version": 1, "rows": snap}
+        try:
+            d = os.path.dirname(os.path.abspath(path)) or "."
+            fd, tmp = tempfile.mkstemp(prefix=".plan_stats.", dir=d)
+            try:
+                with os.fdopen(fd, "w", encoding="utf-8") as f:
+                    json.dump(doc, f, separators=(",", ":"))
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        except OSError:
+            return False
+        return True
+
 
 #: process-wide store the executor feeds; pass to ``rules.optimize`` to
 #: let recurring queries reorder from observed cardinalities.
 GLOBAL = CardinalityStats()
+
+_sidecar_loaded = False
+
+
+def ensure_sidecar_loaded() -> None:
+    """Lazily merge the ``SRJT_PLAN_STATS_PATH`` sidecar into ``GLOBAL``
+    (once per process; callers invoke before reading priors)."""
+    global _sidecar_loaded
+    if _sidecar_loaded:
+        return
+    _sidecar_loaded = True
+    path = knobs.get("SRJT_PLAN_STATS_PATH")
+    if path:
+        GLOBAL.load_sidecar(path)
+
+
+@atexit.register
+def _save_sidecar_at_exit() -> None:
+    # knob re-read at exit: tests that set the env var mid-process and
+    # processes that never touched stats both do the right thing
+    path = knobs.get("SRJT_PLAN_STATS_PATH")
+    if path and len(GLOBAL):
+        GLOBAL.save_sidecar(path)

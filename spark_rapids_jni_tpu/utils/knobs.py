@@ -293,6 +293,19 @@ register("SRJT_PLAN_MAX_PASSES", "10", _int,
          "optimizer fixpoint pass bound", "plan")
 register("SRJT_PLAN_STATS_CAP", "4096", _int,
          "cardinality-stats LRU entry cap", "plan")
+register("SRJT_PLAN_STATS_PATH", None, _opt_str,
+         "JSON sidecar for cardinality stats: loaded at first use for "
+         "warm priors, saved atomically at exit", "plan")
+register("SRJT_AQE", "0", _opt_in,
+         "adaptive query execution: stage-wise replanning on observed "
+         "cardinalities (join reorder, engine flips, skew salting)",
+         "plan")
+register("SRJT_AQE_SKEW_FACTOR", "4.0", _float,
+         "hot-key skew ratio (hottest/mean) at or above which AQE salts "
+         "the repartition join", "plan")
+register("SRJT_AQE_REPLAN_MIN_ROWS", "64", _int,
+         "AQE skips join reorder when every pending input is smaller "
+         "than this (replan overhead not worth it)", "plan")
 
 # parquet scan
 register("SRJT_DICT_STRINGS", "1", _on_unless_0_off,

@@ -1,0 +1,315 @@
+"""Adaptive query execution (SRJT_AQE): differential sweep.
+
+Every adaptive decision — observed-cardinality join reorder, dense↔sorted
+engine flip, skew-salted sub-joins — must be BIT-IDENTICAL to the static
+plan and to the pandas oracle; with ``SRJT_AQE=0`` execution is
+byte-for-byte the static path.  Replay consistency rides the same
+discipline as capture/replay: decisions derive only from host-visible
+row counts and ``syncs.scalar`` reads.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+import jax.numpy as jnp
+
+import spark_rapids_jni_tpu as sr
+from spark_rapids_jni_tpu.column import Column, Table, force_column
+from spark_rapids_jni_tpu.ops import join_plan
+from spark_rapids_jni_tpu.plan import adaptive, ir, lower
+from spark_rapids_jni_tpu.plan import stats as plan_stats
+from spark_rapids_jni_tpu.utils import metrics
+
+N_DEV = 8
+
+
+def _col(a):
+    return Column.from_numpy(np.asarray(a))
+
+
+def _rows(table):
+    cols = [force_column(c).to_numpy().tolist() for c in table]
+    return sorted(zip(*cols)) if cols else []
+
+
+@pytest.fixture
+def mx():
+    metrics.set_enabled(True)
+    metrics.reset()
+    yield metrics
+    metrics.set_enabled(None)
+
+
+@pytest.fixture
+def star():
+    """Fact + big non-selective dim + small selective dim, plus an
+    adversarially-ordered plan tree (big dim joins first)."""
+    rng = np.random.default_rng(21)
+    n = 6000
+    tables = {
+        "fact": Table([_col(rng.integers(0, 900, n).astype(np.int64)),
+                       _col(rng.integers(0, 400, n).astype(np.int64)),
+                       _col(rng.integers(1, 9, n).astype(np.int64))]),
+        "dim_big": Table([_col(np.arange(900, dtype=np.int64)),
+                          _col((np.arange(900) % 11).astype(np.int32))]),
+        "dim_small": Table([_col(np.arange(24, dtype=np.int64)),
+                            _col((np.arange(24) % 3).astype(np.int32))]),
+    }
+    schemas = {"fact": ["f_big_sk", "f_small_sk", "f_qty"],
+               "dim_big": ["big_sk", "b_tag"],
+               "dim_small": ["small_sk", "s_tag"]}
+    tree = ir.FusedJoinAggregate(
+        ir.Join(ir.Scan("fact"), ir.Scan("dim_big"),
+                ("f_big_sk",), ("big_sk",)),
+        ir.Scan("dim_small"), ("f_small_sk",), ("small_sk",),
+        ("b_tag",), (("f_qty", "sum", "total"), ("f_qty", "count", "cnt")))
+    return tables, schemas, tree
+
+
+def _star_oracle(tables):
+    f = pd.DataFrame({
+        "f_big_sk": force_column(tables["fact"][0]).to_numpy(),
+        "f_small_sk": force_column(tables["fact"][1]).to_numpy(),
+        "f_qty": force_column(tables["fact"][2]).to_numpy()})
+    big = pd.DataFrame({
+        "big_sk": force_column(tables["dim_big"][0]).to_numpy(),
+        "b_tag": force_column(tables["dim_big"][1]).to_numpy()})
+    small = pd.DataFrame({
+        "small_sk": force_column(tables["dim_small"][0]).to_numpy(),
+        "s_tag": force_column(tables["dim_small"][1]).to_numpy()})
+    j = f.merge(big, left_on="f_big_sk", right_on="big_sk")
+    j = j.merge(small, left_on="f_small_sk", right_on="small_sk")
+    g = j.groupby("b_tag")["f_qty"].agg(["sum", "count"]).reset_index()
+    return sorted(zip(g["b_tag"].tolist(), g["sum"].tolist(),
+                      g["count"].tolist()))
+
+
+def test_aqe_off_is_static_path(star, mx, monkeypatch):
+    monkeypatch.setenv("SRJT_AQE", "0")
+    tables, schemas, tree = star
+    got = lower.execute(tree, lower.TableCatalog(tables, schemas),
+                        record_stats=False)
+    assert _rows(got) == _star_oracle(tables)
+    # no adaptive machinery ran
+    snap = metrics.snapshot()["counters"]
+    assert not any(k.startswith("plan.aqe") for k in snap), snap
+
+
+def test_replan_adversarial_order_bit_identical(star, mx, monkeypatch):
+    tables, schemas, tree = star
+    monkeypatch.setenv("SRJT_AQE", "0")
+    static = lower.execute(tree, lower.TableCatalog(tables, schemas),
+                           record_stats=False)
+    monkeypatch.setenv("SRJT_AQE", "1")
+    report = adaptive.AdaptiveReport()
+    got = adaptive.execute_adaptive(
+        tree, lower.TableCatalog(tables, schemas), record_stats=False,
+        report=report)
+    assert _rows(got) == _rows(static) == _star_oracle(tables)
+    assert metrics.counter_value("plan.aqe.replan.fired") >= 1
+    assert "replan" in {d.kind for d in report.decisions()}
+    assert "Adaptive execution" in report.render()
+
+
+def test_execute_routes_on_knob(star, monkeypatch):
+    tables, schemas, tree = star
+    monkeypatch.setenv("SRJT_AQE", "1")
+    via_route = lower.execute(tree, lower.TableCatalog(tables, schemas),
+                              record_stats=False)
+    assert _rows(via_route) == _star_oracle(tables)
+
+
+@pytest.fixture
+def sparse():
+    """600 build keys scattered over [0, 15k): static prior says sorted,
+    the observed probe cardinality (20k rows) says dense."""
+    rng = np.random.default_rng(4)
+    n = 20_000
+    tables = {
+        "fact": Table([_col(rng.integers(0, 15_000, n).astype(np.int64)),
+                       _col(rng.integers(1, 9, n).astype(np.int64))]),
+        "dim": Table([_col(rng.permutation(15_000)[:600].astype(np.int64)),
+                      _col((np.arange(600) % 7).astype(np.int32))]),
+    }
+    schemas = {"fact": ["f_sk", "f_qty"], "dim": ["d_sk", "d_tag"]}
+    tree = ir.FusedJoinAggregate(
+        ir.Scan("fact"), ir.Scan("dim"), ("f_sk",), ("d_sk",),
+        ("d_tag",), (("f_qty", "sum", "total"),))
+    return tables, schemas, tree
+
+
+def test_engine_flip_bit_identical(sparse, mx, monkeypatch):
+    tables, schemas, tree = sparse
+    monkeypatch.setenv("SRJT_AQE", "0")
+    static = lower.execute(tree, lower.TableCatalog(tables, schemas),
+                           record_stats=False)
+    monkeypatch.setenv("SRJT_AQE", "1")
+    report = adaptive.AdaptiveReport()
+    got = adaptive.execute_adaptive(
+        tree, lower.TableCatalog(tables, schemas), record_stats=False,
+        report=report)
+    assert _rows(got) == _rows(static)
+    assert metrics.counter_value("plan.aqe.engine_flip.fired") >= 1
+    assert metrics.counter_value("plan.aqe.engine_flip.dense") >= 1
+    assert "engine_flip" in {d.kind for d in report.decisions()}
+
+
+def test_ambient_force_engine_wins_over_probe(sparse, mx, monkeypatch):
+    # scheduler degradation forces an engine ambient-wide; AQE must not
+    # fight it (the probe is skipped entirely)
+    tables, schemas, tree = sparse
+    monkeypatch.setenv("SRJT_AQE", "1")
+    report = adaptive.AdaptiveReport()
+    with join_plan.force_engine("sorted"):
+        got = adaptive.execute_adaptive(
+            tree, lower.TableCatalog(tables, schemas), record_stats=False,
+            report=report)
+    monkeypatch.setenv("SRJT_AQE", "0")
+    static = lower.execute(tree, lower.TableCatalog(tables, schemas),
+                           record_stats=False)
+    assert _rows(got) == _rows(static)
+    assert "engine_flip" not in {d.kind for d in report.decisions()}
+    assert metrics.counter_value("plan.aqe.engine_flip.fired") == 0
+
+
+def test_regression_fires_flight_incident(sparse, mx, monkeypatch):
+    tables, schemas, tree = sparse
+    monkeypatch.setenv("SRJT_AQE", "1")
+    # adversarial prior: the stats sidecar claims this stage yields 1 row,
+    # the observed output is >2x that → regression incident
+    plan_stats.GLOBAL.observe(ir.fingerprint(tree), 1)
+    try:
+        adaptive.execute_adaptive(
+            tree, lower.TableCatalog(tables, schemas), record_stats=False)
+        assert metrics.counter_value("plan.aqe.regression") >= 1
+        assert metrics.counter_value("flight.incident.aqe_regression") >= 1
+    finally:
+        plan_stats.GLOBAL.clear()
+
+
+def test_capture_replay_with_aqe(star, monkeypatch):
+    from spark_rapids_jni_tpu.models.compiled import compile_query
+
+    tables, schemas, tree = star
+    monkeypatch.setenv("SRJT_AQE", "0")
+    static = lower.execute(tree, lower.TableCatalog(tables, schemas),
+                           record_stats=False)
+    monkeypatch.setenv("SRJT_AQE", "1")
+    qfn = lower.compile_plan(tree, schemas)
+    assert getattr(qfn, "aqe_variant", "") == "aqe"
+    cq = compile_query(qfn, tables)          # capture: decisions sync'd
+    replayed = cq.run(tables)                # replay: same host branches
+    assert _rows(replayed) == _rows(static)
+    assert qfn.last_report is not None
+    assert len(qfn.last_report.decisions()) >= 1
+
+
+def test_plan_cache_variant_separates_aqe(star, monkeypatch):
+    from spark_rapids_jni_tpu.exec.plan_cache import PlanCache
+
+    tables, schemas, tree = star
+    monkeypatch.setenv("SRJT_AQE", "0")
+    static_qfn = lower.compile_plan(tree, schemas)
+    monkeypatch.setenv("SRJT_AQE", "1")
+    aqe_qfn = lower.compile_plan(tree, schemas)
+    cache = PlanCache(cap=8)
+    e1 = cache.get_or_compile("q", static_qfn, tables)
+    e2 = cache.get_or_compile("q", aqe_qfn, tables)
+    assert e1 is not e2, "AQE qfn adopted the static tape"
+    # same variants hit their own entries
+    assert cache.get_or_compile("q", static_qfn, tables) is e1
+    assert cache.get_or_compile("q", aqe_qfn, tables) is e2
+
+
+def test_stats_sidecar_roundtrip(tmp_path, mx):
+    path = tmp_path / "stats.json"
+    st = plan_stats.CardinalityStats(max_entries=8)
+    st.observe("plan:a", 10)
+    st.observe("plan:b", 20)
+    assert st.save_sidecar(str(path))
+    st2 = plan_stats.CardinalityStats(max_entries=8)
+    assert st2.load_sidecar(str(path)) == 2
+    assert dict(st2._rows) == {"plan:a": 10, "plan:b": 20}
+    # live observations outrank persisted ones: a fresh observe for a
+    # loaded fingerprint keeps the new value
+    st2.observe("plan:a", 99)
+    assert dict(st2._rows)["plan:a"] == 99
+    # corrupt file → load returns 0, never raises
+    path.write_text("{not json")
+    assert plan_stats.CardinalityStats(max_entries=8).load_sidecar(
+        str(path)) == 0
+
+
+def test_sidecar_loaded_via_knob(tmp_path, monkeypatch):
+    path = tmp_path / "stats.json"
+    st = plan_stats.CardinalityStats(max_entries=8)
+    st.observe("plan:seed", 7)
+    assert st.save_sidecar(str(path))
+    monkeypatch.setenv("SRJT_PLAN_STATS_PATH", str(path))
+    monkeypatch.setattr(plan_stats, "_sidecar_loaded", False)
+    before = len(plan_stats.GLOBAL)
+    try:
+        plan_stats.ensure_sidecar_loaded()
+        assert len(plan_stats.GLOBAL) >= before
+        assert dict(plan_stats.GLOBAL._rows).get("plan:seed") == 7
+    finally:
+        plan_stats.GLOBAL.clear()
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from spark_rapids_jni_tpu.parallel import make_mesh
+    return make_mesh(N_DEV, "data")
+
+
+def test_salted_subjoin_zipf_bit_identical(mesh, mx, monkeypatch):
+    from spark_rapids_jni_tpu.parallel import repartition_join as rj
+
+    rng = np.random.default_rng(17)
+    n, nb, G = 16_384, 512, 16
+    fk = np.minimum(rng.zipf(2.0, n), nb) - 1        # Zipf-skewed keys
+    fk = fk.astype(np.int64)
+    fv = rng.integers(-30, 30, n).astype(np.int64)
+    bk = np.arange(nb, dtype=np.int64)
+    bg = rng.integers(0, G, nb).astype(np.int32)
+    fvld = np.ones((n, 2), bool)
+    fvld[:, 0] = rng.random(n) < 0.95                # some null keys
+    args = (mesh, (sr.int64, sr.int64), (sr.int64, sr.int32),
+            0, 0, 1, 1, G,
+            (jnp.asarray(fk), jnp.asarray(fv)), jnp.asarray(fvld),
+            (jnp.asarray(bk), jnp.asarray(bg)), jnp.ones((nb, 2), bool))
+    monkeypatch.setenv("SRJT_AQE", "0")
+    s1, c1, d1 = rj.repartition_join_agg_auto(*args, salt=1)
+    monkeypatch.setenv("SRJT_AQE", "1")
+    sA, cA, dA = rj.repartition_join_agg_auto(*args)
+    s4, c4, d4 = rj.repartition_join_agg_auto(*args, salt=4)
+    assert int(np.asarray(d1)) == int(np.asarray(dA)) == \
+        int(np.asarray(d4)) == 0
+    # pandas oracle
+    f = pd.DataFrame({"k": fk, "v": fv})[fvld[:, 0]]
+    b = pd.DataFrame({"k": bk, "g": bg})
+    j = f.merge(b, on="k")
+    o = j.groupby("g")["v"].agg(["sum", "count"]).reindex(
+        range(G), fill_value=0)
+    np.testing.assert_array_equal(np.asarray(s1), o["sum"].to_numpy())
+    np.testing.assert_array_equal(np.asarray(c1), o["count"].to_numpy())
+    # salted merges are exact: bit-identical to the unsalted join
+    np.testing.assert_array_equal(np.asarray(sA), np.asarray(s1))
+    np.testing.assert_array_equal(np.asarray(cA), np.asarray(c1))
+    np.testing.assert_array_equal(np.asarray(s4), np.asarray(s1))
+    np.testing.assert_array_equal(np.asarray(c4), np.asarray(c1))
+    assert metrics.counter_value("plan.aqe.skew_split.fired") >= 1
+
+
+def test_salt_validation(mesh):
+    from spark_rapids_jni_tpu.parallel import repartition_join as rj
+
+    n, nb = 64, 16
+    args = (mesh, (sr.int64, sr.int64), (sr.int64, sr.int32),
+            0, 0, 1, 1, 4,
+            (jnp.zeros(n, jnp.int64), jnp.zeros(n, jnp.int64)),
+            jnp.ones((n, 2), bool),
+            (jnp.zeros(nb, jnp.int64), jnp.zeros(nb, jnp.int32)),
+            jnp.ones((nb, 2), bool))
+    with pytest.raises(ValueError, match="power of two"):
+        rj.repartition_join_agg_auto(*args, salt=3)

@@ -241,6 +241,15 @@ def main():
                         entry["plan"] = prules.explain(
                             tpcds_plans.PLANS[name](),
                             tpcds_plans.TABLE_SCHEMAS)
+                        if knobs.get("SRJT_AQE"):
+                            # adaptive EXPLAIN: re-executes the optimized
+                            # tree stage-by-stage and annotates each stage
+                            # with the AQE rules that fired
+                            from spark_rapids_jni_tpu.plan import adaptive
+                            entry["plan_adaptive"] = \
+                                adaptive.explain_adaptive(
+                                    tpcds_plans.PLANS[name](),
+                                    tpcds_plans.TABLE_SCHEMAS, tables)
                 except Exception as e:          # noqa: BLE001
                     entry["plan"] = f"explain failed: {e!r}"
             if use_metrics:
