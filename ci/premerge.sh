@@ -66,6 +66,8 @@ if [[ "${1:-}" != "--skip-tests" ]]; then
     ci/dict_smoke.sh
     echo "== bytes smoke (staged/pipelined/donated scan) =="
     ci/bytes_smoke.sh
+    echo "== profile smoke (EXPLAIN ANALYZE / per-node profiles) =="
+    ci/profile_smoke.sh
 fi
 
 echo "premerge OK"

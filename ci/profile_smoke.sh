@@ -1,0 +1,154 @@
+#!/usr/bin/env bash
+# Query-profiling smoke — the EXPLAIN ANALYZE gate: run two TPC-DS plan
+# queries (q3, q65) with SRJT_PROFILE=1 and assert (1) the profiled
+# result is bit-identical to the unprofiled execution, (2) every node's
+# observed rows landed in the profile and mispredictions are computed,
+# (3) the exported Chrome trace (with plan.node:* spans nested under the
+# query span) parses as JSON and trace_report --by-node renders it,
+# (4) the profile JSON artifact lands in SRJT_PROFILE_DIR and
+# profile_report.py renders/regression-checks it, and (5) the compile
+# ledger shows up in metrics.to_prometheus() and the exposition still
+# passes the text-format lint.
+# Artifacts land in target/profile_smoke/ for workflow upload.
+#
+# Usage: ci/profile_smoke.sh [n_sales]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N_SALES="${1:-50000}"
+OUT=target/profile_smoke
+rm -rf "$OUT"
+mkdir -p "$OUT"
+
+echo "== profile smoke: q3+q65 over $N_SALES rows =="
+XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+SPARK_RAPIDS_TPU_METRICS=1 \
+SRJT_PROFILE_DIR="$OUT/profiles" \
+SRJT_SMOKE_OUT="$OUT" SRJT_SMOKE_N="$N_SALES" \
+python - <<'PYEOF'
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+out = os.environ["SRJT_SMOKE_OUT"]
+n_sales = int(os.environ["SRJT_SMOKE_N"])
+
+import numpy as np
+
+from benchmarks import tpcds_data
+from spark_rapids_jni_tpu import plan as P
+from spark_rapids_jni_tpu.column import force_column
+from spark_rapids_jni_tpu.models import tpcds, tpcds_plans
+from spark_rapids_jni_tpu.models.compiled import compile_query
+from spark_rapids_jni_tpu.plan import lower, profile
+from spark_rapids_jni_tpu.utils import metrics
+
+files = tpcds_data.generate(n_sales=n_sales, n_items=2_000, seed=5)
+tables = tpcds.load_tables(files)
+
+metrics.reset()
+profile.reset()
+
+def rows(t):
+    out = []
+    for c in t.columns:
+        fc = force_column(c)
+        out.append(np.asarray(fc.data))
+    return out
+
+for qname in ("q3", "q65"):
+    tree = tpcds_plans.optimized(qname).tree
+    cat = lower.TableCatalog(tables, tpcds_plans.TABLE_SCHEMAS)
+    plain = lower.execute(tree, cat, record_stats=False)
+
+    # profiled execution: explain_analyze force-enables SRJT_PROFILE
+    text = profile.explain_analyze(
+        tree, tpcds_plans.TABLE_SCHEMAS, tables)
+    assert "rows est=" in text and "obs=" in text, text[:400]
+    prof = profile.completed(last=1)[0]
+
+    profile.set_enabled(True)
+    try:
+        with profile.query(qname, P.fingerprint(tree)) as pr:
+            got = lower.execute(
+                tree, lower.TableCatalog(
+                    tables, tpcds_plans.TABLE_SCHEMAS),
+                record_stats=False)
+    finally:
+        profile.set_enabled(None)
+
+    # (1) bit-identical under profiling
+    a, b = rows(plain), rows(got)
+    assert len(a) == len(b)
+    for i, (x, y) in enumerate(zip(a, b)):
+        np.testing.assert_array_equal(x, y, err_msg=f"{qname} col {i}")
+    # (2) every closed node carries observed rows
+    nodes = list(pr.nodes())
+    assert nodes, "no profiled nodes"
+    assert all(n.out_rows is not None for n in nodes), nodes
+    root = pr.roots[0]
+    assert root.out_rows == plain.num_rows, (root.out_rows,
+                                             plain.num_rows)
+    with open(os.path.join(out, f"{qname}_explain_analyze.txt"),
+              "w") as f:
+        f.write(text)
+    print(f"{qname}: profiled bit-identical, "
+          f"{len(nodes)} nodes, root rows {root.out_rows}")
+
+# (3) Chrome trace with plan.node spans, valid JSON
+trace_path = metrics.export_chrome_trace(os.path.join(out, "trace.json"))
+with open(trace_path) as f:
+    doc = json.load(f)
+names = {ev.get("name") for ev in doc["traceEvents"]}
+assert any(str(n).startswith("plan.node:") for n in names), sorted(names)
+assert "srjtLedger" in doc, list(doc)
+print(f"chrome trace OK: {len(doc['traceEvents'])} events "
+      f"({sum(1 for n in names if str(n).startswith('plan.node:'))} "
+      f"node span names)")
+
+# (4) profile artifacts landed; reports render
+pdir = os.environ["SRJT_PROFILE_DIR"]
+arts = sorted(os.listdir(pdir))
+assert arts, f"no profile artifacts in {pdir}"
+for a in arts:
+    with open(os.path.join(pdir, a)) as f:
+        json.load(f)
+print(f"profile artifacts OK: {arts}")
+
+# (5) compile ledger present in the Prometheus exposition + lint.
+# compile_query exercises capture + trace + first dispatch.
+cq = compile_query(tpcds.q3, tables)
+cq.run(tables)
+led = metrics.ledger_snapshot()
+assert any(v.get("captures") for v in led.values()), led
+assert any(v.get("traces") for v in led.values()), led
+import re
+prom = metrics.to_prometheus()
+assert "srjt_compile_ledger" in prom, prom[-800:]
+line_re = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\"(,[a-zA-Z_][a-zA-Z0-9_]*="
+    r"\"[^\"]*\")*\})? (-?[0-9.e+-]+|\+Inf|-Inf|NaN)$")
+type_re = re.compile(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                     r"(counter|gauge|histogram)$")
+for ln in prom.splitlines():
+    assert (type_re.match(ln) if ln.startswith("#")
+            else line_re.match(ln)), f"prometheus lint: bad line {ln!r}"
+with open(os.path.join(out, "metrics.prom"), "w") as f:
+    f.write(prom)
+print(f"prometheus lint OK: {len(prom.splitlines())} lines, "
+      f"ledger plans: {len(led)}")
+PYEOF
+
+echo "== trace_report --by-node =="
+python tools/trace_report.py "$OUT/trace.json" 12 --by-node
+
+echo "== profile_report =="
+python tools/profile_report.py "$OUT/profiles" 12
+# self-comparison must report zero regressions (exit 0)
+python tools/profile_report.py "$OUT/profiles" 5 --regress "$OUT/profiles"
+
+echo "profile smoke OK"

@@ -162,6 +162,9 @@ class PlanCache:
                 if entry is not None:
                     if metrics.recording():
                         metrics.count("exec.plan_cache.hit")
+                        metrics.ledger_add(
+                            getattr(qfn, "plan_fingerprint", None) or name,
+                            cache_hits=1)
                     return entry
                 ev = self._building.get(key)
                 if ev is None:
@@ -178,10 +181,16 @@ class PlanCache:
             if shared is not None:
                 if metrics.recording():
                     metrics.count("exec.plan_cache.size_hit")
+                    metrics.ledger_add(
+                        getattr(qfn, "plan_fingerprint", None) or name,
+                        cache_size_hits=1)
                 plan, expected = shared, None
             else:
                 if metrics.recording():
                     metrics.count("exec.plan_cache.miss")
+                    metrics.ledger_add(
+                        getattr(qfn, "plan_fingerprint", None) or name,
+                        cache_misses=1)
                 plan = C.compile_query(qfn, tables)
                 # the capture run's result IS this request's answer: hand
                 # it out once instead of re-executing, and drop the

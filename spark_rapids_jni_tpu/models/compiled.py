@@ -39,6 +39,7 @@ memo is disabled under capture/replay so tapes stay aligned.)
 from __future__ import annotations
 
 import itertools
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -80,23 +81,38 @@ class CompiledQuery:
 
     def __init__(self, qfn: Callable, tables: Any):
         qname = self.name = getattr(qfn, "__name__", "query")
+        # the compile-cost ledger keys on the plan fingerprint when the
+        # qfn carries one (plan/lower.compile_plan does), else the name —
+        # the ROADMAP cold-start item's attribution unit
+        self._ledger_key = getattr(qfn, "plan_fingerprint", None) or qname
         tape: list[int] = []
         metrics.count("compiled.capture")
+        t0 = time.perf_counter()
         with metrics.span(f"compiled.capture:{qname}"):
             with syncs.capture(tape):
                 # eager capture run (and oracle)
                 self.expected = _materialized(qfn(tables))
+        metrics.ledger_add(self._ledger_key, captures=1,
+                           capture_ms=(time.perf_counter() - t0) * 1e3)
         self.tape = tuple(tape)
         metrics.observe("compiled.tape_len", len(self.tape))
         self._trace_key = f"{qname}#{next(_plan_serial)}"
+        self._dispatched = False
 
         def _traced(tbls):
             # counted at trace time on purpose: each execution of this
             # body IS one (re)trace → XLA recompile of the query program
             metrics.count("compiled.recompile", in_trace=True)
             sanitize.note_trace(self._trace_key)
+            tt0 = time.perf_counter()
             with syncs.replay(list(self.tape)):
-                return _materialized(qfn(tbls))
+                out = _materialized(qfn(tbls))
+            # traces-1 == recompiles of this plan; trace_ms is the Python
+            # re-trace cost (XLA compile itself lands in the surrounding
+            # first_dispatch_ms)
+            metrics.ledger_add(self._ledger_key, traces=1, in_trace=True,
+                               trace_ms=(time.perf_counter() - tt0) * 1e3)
+            return out
         _traced.__name__ = f"compiled_{qname}"
         self._traced_fn = _traced
         self._prog = jax.jit(_traced)
@@ -146,7 +162,23 @@ class CompiledQuery:
                         "the refreshed tables")
             metrics.count("compiled.replay_run")
             with metrics.span("compiled.dispatch"):
-                return self._prog(tables)
+                return self._ledger_dispatch(tables)
+
+    def _ledger_dispatch(self, tables):
+        """Dispatch with compile-ledger attribution (metrics-enabled
+        paths only — the disabled steady loop calls ``_prog`` directly).
+        The first dispatch of the jitted program carries the XLA compile,
+        so its wall time is the plan's compile cost."""
+        if self._dispatched:
+            metrics.ledger_add(self._ledger_key, runs=1)
+            return self._prog(tables)
+        t0 = time.perf_counter()
+        out = self._prog(tables)
+        self._dispatched = True
+        metrics.ledger_add(
+            self._ledger_key, runs=1, first_dispatches=1,
+            first_dispatch_ms=(time.perf_counter() - t0) * 1e3)
+        return out
 
     def run_unchecked(self, tables):
         """Steady-loop execution: no staleness check, one dispatch.
@@ -158,7 +190,7 @@ class CompiledQuery:
             return self._prog(tables)
         metrics.count("compiled.replay_run")
         with metrics.span(f"compiled.run_unchecked:{self.name}"):
-            return self._prog(tables)
+            return self._ledger_dispatch(tables)
 
     def run_vmapped(self, tables_list) -> Optional[list]:
         """Execute K same-shaped table sets as ONE vmapped dispatch of the

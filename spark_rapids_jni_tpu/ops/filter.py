@@ -109,8 +109,9 @@ def sized_nonzero(mask: jnp.ndarray, n_keep: int) -> jnp.ndarray:
 
 def apply_boolean_mask(table: Table, mask: jnp.ndarray) -> Table:
     """Keep rows where mask is True (compacting; one host sync for the count)."""
-    from ..utils import syncs
+    from ..utils import metrics, syncs
     n_keep = syncs.scalar(jnp.sum(mask))   # counted host sync (dynamic size)
+    metrics.profile_op("filter", rows_in=table.num_rows, rows_kept=n_keep)
     idx = sized_nonzero(mask, n_keep)
     return gather(table, idx)
 

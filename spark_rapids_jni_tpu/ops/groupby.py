@@ -214,6 +214,7 @@ def _groupby_aggregate(table: Table, key_indices: Sequence[int],
     if metrics.recording():
         metrics.observe("groupby.groups", num_segments)
         metrics.annotate(groups=num_segments)
+    metrics.profile_op("groupby", rows_in=n, groups=num_segments)
     return _aggregate_sorted(sorted_tbl, list(key_indices), str_dicts,
                              seg_ids, num_segments, aggs, n)
 

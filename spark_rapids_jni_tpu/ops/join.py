@@ -111,6 +111,8 @@ def _join_indices(lcols: list, rcols: list, how: str):
             total = syncs.scalar(jnp.sum(counts))   # scalar sync (pair count)
             if metrics.recording():
                 metrics.observe("join.match_rows", total)
+            metrics.profile_op("join", engine=ix.kind, how=how,
+                               match_rows=total, unique_build=True)
             left_idx = sized_nonzero(counts > 0, total)
             right_idx = ix.row_ids[pos[left_idx]]
             return left_idx, right_idx
@@ -137,6 +139,9 @@ def _join_indices(lcols: list, rcols: list, how: str):
         metrics.observe("join.match_rows",
                         total if matched_rows is None else matched_rows)
         metrics.annotate(expand_pairs=total)
+    metrics.profile_op(
+        "join", engine=ix.kind, how=how, expand_pairs=total,
+        match_rows=total if matched_rows is None else matched_rows)
     # admission-control the ephemeral expansion working set (the int64
     # lanes + mask below) before XLA materializes it; under pressure this
     # spills LRU arena residents first (soft: an admitted query completes)
@@ -198,6 +203,8 @@ def _verified_join(plan, ix, lo, counts, how: str):
         metrics.count("join.verify.collisions", int(li.shape[0]) - kept)
         if how in ("inner", "left"):
             metrics.observe("join.match_rows", kept)
+    metrics.profile_op("join", engine=ix.kind, how=how,
+                       candidates=int(li.shape[0]), match_rows=kept)
     sel = sized_nonzero(eq, kept)
     li, ri = li[sel], ri[sel]
     if how == "inner":

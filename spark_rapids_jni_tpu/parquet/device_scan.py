@@ -1167,6 +1167,8 @@ def scan_table(file_bytes: bytes,
         if metrics.recording():
             metrics.count("plan.scan.rowgroups_pruned", len(pruned))
             metrics.count("plan.scan.rowgroups_kept", len(kept))
+        metrics.profile_op("scan.prune", rowgroups_pruned=len(pruned),
+                           rowgroups_kept=len(kept))
     selecting = len(kept) < len(groups_list)
     if not kept:
         # every row group pruned: zero-row table via the host assembler
@@ -1331,6 +1333,8 @@ def scan_table(file_bytes: bytes,
         for j, i in enumerate(fallback):
             by_index[i] = host[j]
     out = Table([by_index[i] for i in want])
+    metrics.profile_op("scan", rows_out=out.num_rows, cols=len(want),
+                       rowgroups=len(kept), fallback_cols=len(fallback))
     if filter_state is not None:
         # the planner checks this to skip the redundant re-apply: True
         # means every conjunct was evaluated and pruned at scan time

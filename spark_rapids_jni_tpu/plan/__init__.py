@@ -8,7 +8,7 @@ hand-fused op sequence — bit-identical results, composing unchanged with
 capture/replay and the serving runtime.
 """
 
-from . import adaptive, ir, lower, rules, stats
+from . import adaptive, ir, lower, profile, rules, stats
 from .adaptive import (AdaptiveReport, compile_adaptive_plan,
                        execute_adaptive, explain_adaptive)
 from .ir import (Aggregate, And, Between, Cmp, Col, Filter,
@@ -17,12 +17,14 @@ from .ir import (Aggregate, And, Between, Cmp, Col, Filter,
                  expr_columns, fingerprint, render, schema_of)
 from .lower import (FileCatalog, TableCatalog, compile_plan, execute,
                     rowgroup_conditions)
+from .profile import NodeProfile, QueryProfile, explain_analyze
 from .rules import DEFAULT_RULES, OptimizeResult, explain, optimize
 from .stats import GLOBAL as GLOBAL_STATS
 from .stats import CardinalityStats
 
 __all__ = [
-    "ir", "lower", "rules", "stats", "adaptive",
+    "ir", "lower", "rules", "stats", "adaptive", "profile",
+    "NodeProfile", "QueryProfile", "explain_analyze",
     "AdaptiveReport", "compile_adaptive_plan", "execute_adaptive",
     "explain_adaptive",
     "Plan", "PlanError", "Scan", "Filter", "Project", "Join", "Aggregate",

@@ -60,7 +60,7 @@ import numpy as np
 from .. import types as T
 from ..ops import join_plan
 from ..utils import flight, knobs, metrics, syncs
-from . import ir, lower
+from . import ir, lower, profile
 from . import stats as plan_stats
 
 #: observed rows > this factor × the prior estimate, on a stage where a
@@ -270,9 +270,30 @@ class _Exec:
         if isinstance(node, ir.FusedJoinAggregate):
             chain = _collect_chain(node)
             if chain is not None and len(chain[1]) >= 2:
-                return self._run_chain(node, *chain)
-        kids = [self.run(k) for k in ir.children(node)]
-        return self._apply(node, kids)
+                ctx = profile.node_enter(node)
+                if ctx is None:
+                    return self._run_chain(node, *chain)
+                res = None
+                try:
+                    res = self._run_chain(node, *chain)
+                finally:
+                    # the chain record is the replan REGION: its
+                    # children are the executed base/dim subtrees plus
+                    # the synthesized spine in its chosen order
+                    profile.node_exit(
+                        ctx, None if res is None else res[0])
+                return res
+        ctx = profile.node_enter(node)
+        if ctx is None:
+            kids = [self.run(k) for k in ir.children(node)]
+            return self._apply(node, kids)
+        t = kids = None
+        try:
+            kids = [self.run(k) for k in ir.children(node)]
+            t, names = self._apply(node, kids)
+        finally:
+            profile.node_exit(ctx, t, kids)
+        return t, names
 
     # . one barrier stage .....................................................
 
@@ -326,6 +347,10 @@ class _Exec:
                 t, names = lower._apply_node(node, kids, self.catalog,
                                              self.record_stats)
         stage.rows = t.num_rows
+        if force is not None:
+            profile.annotate_node(engine=force)
+        for d in stage.decisions:
+            profile.annotate_node(decision=f"{d.kind}: {d.detail}")
         self._check_regression(stage)
         return t, names
 
@@ -382,16 +407,30 @@ class _Exec:
         for j in order[:-1]:
             d = dims[j]
             jn = ir.Join(cur_plan, d.plan, d.left_on, d.right_on, "inner")
-            cur_res = self._apply(jn, [cur_res, dim_res[j]],
-                                  extra_decisions=decisions)
+            cur_res = self._apply_staged(jn, [cur_res, dim_res[j]],
+                                         extra_decisions=decisions)
             decisions = []          # attach replan to the first stage only
             cur_plan = jn
         last = dims[order[-1]]
         fnode = ir.FusedJoinAggregate(
             cur_plan, last.plan, last.left_on, last.right_on,
             fja.keys, fja.aggs, fja.how)
-        return self._apply(fnode, [cur_res, dim_res[order[-1]]],
-                           extra_decisions=decisions)
+        return self._apply_staged(fnode, [cur_res, dim_res[order[-1]]],
+                                  extra_decisions=decisions)
+
+    def _apply_staged(self, node: ir.Plan, kids,
+                      extra_decisions: Optional[list] = None):
+        """One synthesized spine node (``_run_chain``): profiled like a
+        ``run()`` node so the EXECUTED join order shows in the tree."""
+        ctx = profile.node_enter(node)
+        if ctx is None:
+            return self._apply(node, kids, extra_decisions)
+        res = None
+        try:
+            res = self._apply(node, kids, extra_decisions)
+        finally:
+            profile.node_exit(ctx, None if res is None else res[0], kids)
+        return res
 
 
 # --- entry points ------------------------------------------------------------

@@ -40,6 +40,7 @@ from ..ops import strings as S
 from ..ops import window as W
 from ..utils import metrics
 from . import ir
+from . import profile
 from . import stats as plan_stats
 
 
@@ -401,13 +402,26 @@ def _apply_node(node: ir.Plan, kids: list, catalog, record_stats: bool):
         # static shapes: num_rows is free — feed the reorder rule's
         # exact-cardinality store for the next optimize of this shape
         plan_stats.GLOBAL.observe(ir.fingerprint(node), t.num_rows)
+    # the validity-density sync (SRJT_PROFILE_VALIDITY) lives at this
+    # single funnel so capture and replay resolve the identical tape
+    profile.at_node_output(t)
     return t, names
 
 
 def _execute(node: ir.Plan, catalog, record_stats: bool):
-    kids = [_execute(k, catalog, record_stats)
-            for k in ir.children(node)]
-    return _apply_node(node, kids, catalog, record_stats)
+    ctx = profile.node_enter(node)
+    if ctx is None:
+        kids = [_execute(k, catalog, record_stats)
+                for k in ir.children(node)]
+        return _apply_node(node, kids, catalog, record_stats)
+    t = kids = None
+    try:
+        kids = [_execute(k, catalog, record_stats)
+                for k in ir.children(node)]
+        t, names = _apply_node(node, kids, catalog, record_stats)
+    finally:
+        profile.node_exit(ctx, t, kids)
+    return t, names
 
 
 def execute(tree: ir.Plan, catalog, record_stats: bool = True) -> Table:

@@ -996,6 +996,10 @@ def _record_transcode(prefix: str, rows: int, batches) -> None:
         metrics.count(f"{prefix}.bytes", nbytes)
         metrics.count(f"{prefix}.batches", len(batches))
         metrics.annotate(rows=rows, row_bytes=nbytes)
+    if metrics._profile_op_hook is not None:
+        metrics.profile_op(prefix, rows=rows,
+                           bytes=sum(b.num_bytes for b in batches),
+                           batches=len(batches))
 
 
 def _slice_column(col: Column, lo: int, hi: int) -> Column:

@@ -259,6 +259,23 @@ register("SRJT_SANITIZE", "0", _str,
          "runtime sanitizers: `1` files flight incidents on lock-order "
          "inversions and hot-path retraces, `strict` raises instead "
          "(CI smokes run strict)", "observability")
+register("SRJT_PROFILE", "0", _on_unless_off,
+         "per-plan-node runtime profiling (`plan/profile.py`): rows/"
+         "bytes/time per executed node, `explain_analyze()` rendering; "
+         "off = one bool check on the executor path", "observability")
+register("SRJT_PROFILE_DEVICE_TIME", "1", _on_unless_0_off,
+         "fence each profiled node's output (`block_until_ready`) to "
+         "attribute device time; `0`/`off` records host wall only",
+         "observability")
+register("SRJT_PROFILE_VALIDITY", "0", _opt_in,
+         "per-node validity density in profiles (adds one scalar sync "
+         "per nullable column per node, recorded on the capture/replay "
+         "tape — keep the knob stable across a compiled plan's "
+         "lifetime)", "observability")
+register("SRJT_PROFILE_DIR", None, _opt_str,
+         "directory where per-query profile JSON artifacts land on "
+         "profile close; unset = profiles kept in memory only",
+         "observability")
 
 # ops / joins
 register("SRJT_JOIN_ENGINE", None, _str,
@@ -372,6 +389,9 @@ register("SRJT_QB_STEADY_CAP", "10", _float,
          "query_bench per-query steady-sweep time budget (s)", "tools")
 register("SRJT_QB_EXPLAIN", "0", _is_1,
          "query_bench records `plan.explain` output per query", "tools")
+register("SRJT_QB_PROFILE", "0", _is_1,
+         "query_bench attaches per-plan-node profiles (`--profile`) to "
+         "QUERY_BENCH.json entries", "tools")
 register("SRJT_BENCH_TRIES", "0", _int,
          "bench.py crash-resume attempt counter", "tools")
 register("SRJT_BENCH_BUDGET_S", "1200", _float,

@@ -65,6 +65,17 @@ _EPOCH = time.perf_counter()        # trace time base (ts exported rel. us)
 _tls = threading.local()            # per-thread open-span stack
 _roots: list["Span"] = []           # completed root spans (all threads)
 
+# compile-cost ledger: plan fingerprint → summed cost fields (capture_ms,
+# trace_ms, traces, first_dispatch_ms, runs, cache_hits, ...) — the
+# per-plan attribution of where compilation wall time went
+# (``models/compiled.py`` and ``exec/plan_cache.py`` feed it)
+_ledger: dict[str, dict[str, float]] = {}
+
+# installed by ``plan/profile.py`` when that module loads; ops-layer
+# sites report into the active node profile through :func:`profile_op`
+# without importing plan/ (no cycle, no cost when profiling never loads)
+_profile_op_hook = None
+
 
 def enabled() -> bool:
     return _enabled
@@ -92,13 +103,52 @@ def recording() -> bool:
 
 
 def reset() -> None:
-    """Drop all counters, gauges, histograms, and completed spans."""
+    """Drop all counters, gauges, histograms, completed spans, and the
+    compile-cost ledger."""
     with _lock:
         _counters.clear()
         _gauges.clear()
         _hists.clear()
         _samples.clear()
         _roots.clear()
+        _ledger.clear()
+
+
+def profile_op(name: str, **fields) -> None:
+    """Report one op-level event (host-visible fields only — already
+    resolved ints/strings, never device values) into the active plan-node
+    profile.  A no-op until ``plan/profile.py`` is loaded AND a profile is
+    active; ops-layer sites call this instead of importing plan/."""
+    hook = _profile_op_hook
+    if hook is not None:
+        hook(name, **fields)
+
+
+# --- compile-cost ledger -----------------------------------------------------
+
+
+def ledger_add(plan: str, *, in_trace: bool = False, **fields) -> None:
+    """Accumulate numeric cost ``fields`` (ms, counts) under ``plan`` —
+    a plan fingerprint or query name.  Same gating discipline as
+    :func:`count`: no-op when disabled; ``in_trace=True`` records even
+    under a replay trace (trace time is MEASURED at trace time)."""
+    if not _enabled:
+        return
+    if not in_trace and not recording():
+        return
+    with _lock:
+        e = _ledger.setdefault(plan, {})
+        for k, v in fields.items():
+            e[k] = e.get(k, 0) + v
+
+
+def ledger_snapshot() -> dict[str, dict[str, float]]:
+    """The compile-cost ledger as plain dicts (deep-copied):
+    plan → {capture_ms, trace_ms, traces, first_dispatch_ms, runs,
+    cache_hits, ...}.  ``traces`` counts jit (re)traces of the plan body;
+    ``traces - 1`` of them are recompiles."""
+    with _lock:
+        return {k: dict(v) for k, v in _ledger.items()}
 
 
 # --- counters / gauges / histograms ----------------------------------------
@@ -364,11 +414,12 @@ def sample_hbm(tag: str = "sample") -> Optional[int]:
 
 
 def snapshot() -> dict:
-    """Counters/gauges/histograms as plain dicts (deep-copied)."""
+    """Counters/gauges/histograms/ledger as plain dicts (deep-copied)."""
     with _lock:
         return {"counters": dict(_counters), "gauges": dict(_gauges),
                 "histograms": {k: {**v, "buckets": dict(v["buckets"])}
-                               for k, v in _hists.items()}}
+                               for k, v in _hists.items()},
+                "ledger": {k: dict(v) for k, v in _ledger.items()}}
 
 
 def span_roots() -> list[dict]:
@@ -437,12 +488,13 @@ def chrome_trace() -> dict:
         gauges = dict(_gauges)
         hists = {k: {**v, "buckets": dict(v["buckets"])}
                  for k, v in _hists.items()}
+        ledger = {k: dict(v) for k, v in _ledger.items()}
     for k, v in sorted(counters.items()):
         events.append({"name": k, "cat": "srjt", "ph": "C", "pid": pid,
                        "ts": round(end_us, 3), "args": {"value": v}})
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "srjtCounters": counters, "srjtGauges": gauges,
-            "srjtHistograms": hists}
+            "srjtHistograms": hists, "srjtLedger": ledger}
 
 
 def export_chrome_trace(path: Optional[str] = None) -> str:
@@ -475,6 +527,13 @@ def _prom_num(v: float) -> str:
     return repr(int(f)) if f == int(f) and abs(f) < 2 ** 53 else repr(f)
 
 
+def _prom_label(v: str) -> str:
+    """Escape a label VALUE for the text exposition grammar (the CI lint
+    admits ``[^"]*`` between the quotes — strip anything that would
+    close or continue the quoted string)."""
+    return str(v).replace("\\", "_").replace('"', "_").replace("\n", "_")
+
+
 def to_prometheus() -> str:
     """The registry in Prometheus text exposition format (version 0.0.4).
 
@@ -489,6 +548,7 @@ def to_prometheus() -> str:
         gauges = dict(_gauges)
         hists = {k: {**v, "buckets": dict(v["buckets"])}
                  for k, v in _hists.items()}
+        ledger = {k: dict(v) for k, v in _ledger.items()}
     lines: list[str] = []
     for name, v in sorted(counters.items()):
         p = _prom_name(name)
@@ -510,6 +570,16 @@ def to_prometheus() -> str:
         lines.append(f'{p}_bucket{{le="+Inf"}} {h["count"]}')
         lines.append(f"{p}_sum {_prom_num(h['total'])}")
         lines.append(f"{p}_count {h['count']}")
+    if ledger:
+        # compile-cost attribution: one labeled series per (plan, field)
+        # — `rate(srjt_compile_ledger{kind="trace_ms"}[5m])` answers "who
+        # is recompiling" straight off a scrape
+        p = "srjt_compile_ledger"
+        lines.append(f"# TYPE {p} counter")
+        for plan, e in sorted(ledger.items()):
+            for k, v in sorted(e.items()):
+                lines.append(f'{p}{{plan="{_prom_label(plan)}",'
+                             f'kind="{_prom_label(k)}"}} {_prom_num(v)}')
     return "\n".join(lines) + ("\n" if lines else "")
 
 

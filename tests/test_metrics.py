@@ -90,10 +90,11 @@ def test_disabled_mode_records_nothing():
     metrics.gauge("g", 1)
     metrics.gauge_max("hw", 1)
     metrics.observe("h", 1)
+    metrics.ledger_add("p", captures=1)
     with metrics.span("s"):
         metrics.annotate(x=1)
     assert metrics.snapshot() == {"counters": {}, "gauges": {},
-                                  "histograms": {}}
+                                  "histograms": {}, "ledger": {}}
     assert metrics.span_roots() == []
     # the disabled span context is one SHARED object — no per-call alloc
     assert metrics.span("a") is metrics.span("b")

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Collate every ``*_BENCH.json`` artifact into ``BENCH_TRAJECTORY.json``.
+
+Each smoke/bench script (``ci/*_smoke.sh``, ``tools/query_bench.py``, …)
+leaves a JSON artifact at the repo root; nothing has collated them, so
+the perf trajectory across PRs is invisible.  This tool flattens every
+numeric scalar in each artifact to a dot-path metric and stamps it with
+the artifact's last-touching commit (``git log -1 -- <file>``), producing
+one machine-readable ledger:
+
+    {"generated_from": [...],
+     "metrics": [{"artifact": "JOIN_BENCH.json",
+                  "metric": "benches.fact_dim.speedup",
+                  "value": 3.1,
+                  "commit": "f9fb599",
+                  "subject": "PR 15: ...'"}, ...]}
+
+Downstream, ``tools/profile_report.py --regress`` answers per-node
+questions; this answers the per-PR one ("what did each change buy").
+
+Usage: python tools/bench_history.py [--root DIR] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+
+def _flatten(doc, prefix: str = "") -> list[tuple[str, float]]:
+    """Numeric scalars as (dot.path, value); bools/strings skipped."""
+    out: list[tuple[str, float]] = []
+    if isinstance(doc, dict):
+        for k in sorted(doc):
+            out.extend(_flatten(doc[k], f"{prefix}{k}."))
+    elif isinstance(doc, list):
+        for i, v in enumerate(doc):
+            out.extend(_flatten(v, f"{prefix}{i}."))
+    elif isinstance(doc, (int, float)) and not isinstance(doc, bool):
+        out.append((prefix[:-1], float(doc)))
+    return out
+
+
+def _provenance(root: str, path: str) -> tuple[str, str]:
+    """(short commit, subject) of the commit that last touched ``path``."""
+    try:
+        line = subprocess.run(
+            ["git", "log", "-1", "--format=%h%x09%s", "--",
+             os.path.basename(path)],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        ).stdout.strip()
+        if line:
+            h, _, subj = line.partition("\t")
+            return h, subj
+    except Exception:
+        pass
+    return "", ""
+
+
+def collect(root: str) -> dict:
+    arts = sorted(glob.glob(os.path.join(root, "*_BENCH.json")))
+    metrics = []
+    for path in arts:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except Exception as exc:
+            print(f"warning: skipping {path}: {exc}", file=sys.stderr)
+            continue
+        commit, subject = _provenance(root, path)
+        name = os.path.basename(path)
+        for metric, value in _flatten(doc):
+            metrics.append({"artifact": name, "metric": metric,
+                            "value": value, "commit": commit,
+                            "subject": subject})
+    return {"generated_from": [os.path.basename(a) for a in arts],
+            "metrics": metrics}
+
+
+def main(argv: list[str]) -> int:
+    root = "."
+    out = None
+    args = list(argv[1:])
+    if "--root" in args:
+        i = args.index("--root")
+        root = args[i + 1]
+        del args[i:i + 2]
+    if "--out" in args:
+        i = args.index("--out")
+        out = args[i + 1]
+        del args[i:i + 2]
+    if out is None:
+        out = os.path.join(root, "BENCH_TRAJECTORY.json")
+    doc = collect(root)
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, out)
+    by_art: dict[str, int] = {}
+    for m in doc["metrics"]:
+        by_art[m["artifact"]] = by_art.get(m["artifact"], 0) + 1
+    print(f"{out}: {len(doc['metrics'])} metrics from "
+          f"{len(doc['generated_from'])} artifacts")
+    for art in sorted(by_art):
+        print(f"  {art}: {by_art[art]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

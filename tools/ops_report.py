@@ -95,6 +95,64 @@ def _ingest_lines(counters: dict, events: list) -> list[str]:
     return out or ["  (no byte-path ingest activity recorded)"]
 
 
+def _ledger_lines(ledger: dict) -> list[str]:
+    """The compile-cost ledger table: per plan fingerprint, where the
+    compile budget went (capture/trace ms, recompiles, cache hits)."""
+    if not ledger:
+        return ["  (no compiled plans this process)"]
+    out = []
+    for plan in sorted(ledger):
+        e = ledger[plan]
+        traces = e.get("traces", 0)
+        out.append(
+            f"  {plan}")
+        out.append(
+            f"    captures {e.get('captures', 0):.0f} "
+            f"({e.get('capture_ms', 0):.1f} ms)  "
+            f"traces {traces:.0f} ({e.get('trace_ms', 0):.1f} ms, "
+            f"{max(traces - 1, 0):.0f} recompile)  "
+            f"first-dispatch {e.get('first_dispatch_ms', 0):.1f} ms")
+        out.append(
+            f"    runs {e.get('runs', 0):.0f}  cache hit/size/miss "
+            f"{e.get('cache_hits', 0):.0f}/"
+            f"{e.get('cache_size_hits', 0):.0f}/"
+            f"{e.get('cache_misses', 0):.0f}")
+    return out
+
+
+def _profile_lines(last: int = 3) -> list[str]:
+    """Recent query profiles (``plan/profile.py`` retention ring): the
+    top self-time nodes of each, one line per node."""
+    from spark_rapids_jni_tpu.plan import profile
+    profs = profile.completed(last=last)
+    if not profs:
+        return ["  (no completed query profiles — run with SRJT_PROFILE=1)"]
+    out = []
+    for p in profs:
+        mis = len(p.mispredictions())
+        out.append(f"  {p.name}: wall {p.wall_ms:.1f} ms, "
+                   f"{sum(1 for _ in p.nodes())} nodes, "
+                   f"{mis} mispredicted")
+        top = sorted(p.nodes(), key=lambda n: -n.self_ms())[:4]
+        for n in top:
+            flag = "  MISPREDICT" if n.mispredicted() else ""
+            out.append(f"    {n.self_ms():>8.2f} ms  rows={n.out_rows}  "
+                       f"{n.line}{flag}")
+    return out
+
+
+def _probe_profile_lines(v) -> list[str]:
+    """Render the ``plan.active_profile`` flight probe: per stuck thread,
+    the open node stack (innermost last) of the in-flight query."""
+    out = []
+    for tid, prof in sorted((v or {}).items()):
+        out.append(f"    thread {tid}: {prof.get('name')} "
+                   f"({len(prof.get('nodes') or [])} nodes closed)")
+        for line in prof.get("open") or []:
+            out.append(f"      open: {line}")
+    return out
+
+
 def _slo_lines(slo: dict) -> list[str]:
     th = slo.get("thresholds") or {}
     if not th:
@@ -139,6 +197,10 @@ def report(sched) -> str:
     lines.extend(_attribution_lines(snap["histograms"]))
     lines.append("== ingest attribution ==")
     lines.extend(_ingest_lines(snap.get("counters") or {}, flight.events()))
+    lines.append("== compile ledger ==")
+    lines.extend(_ledger_lines(snap.get("ledger") or {}))
+    lines.append("== query profiles ==")
+    lines.extend(_profile_lines())
     lines.append("== flight ring (newest last) ==")
     for ev in flight.events(last=15):
         extra = {k: v for k, v in ev.items()
@@ -170,13 +232,21 @@ def report_incident(path: str) -> str:
     if probes:
         lines.append("== probes at incident time ==")
         for k, v in sorted(probes.items()):
-            lines.append(f"  {k}: {v}")
+            if k == "plan.active_profile" and isinstance(v, dict):
+                lines.append(f"  {k}: (in-flight node profiles)")
+                lines.extend(_probe_profile_lines(v))
+            else:
+                lines.append(f"  {k}: {v}")
     hists = (snap.get("metrics") or {}).get("histograms") or {}
     lines.append("== latency attribution ==")
     lines.extend(_attribution_lines(hists))
     lines.append("== ingest attribution ==")
     lines.extend(_ingest_lines(
         (snap.get("metrics") or {}).get("counters") or {}, evs))
+    ledger = (snap.get("metrics") or {}).get("ledger")
+    if ledger:
+        lines.append("== compile ledger ==")
+        lines.extend(_ledger_lines(ledger))
     return "\n".join(lines)
 
 
@@ -186,6 +256,7 @@ def report_scrape(url: str) -> str:
     from urllib.request import urlopen
     text = urlopen(url, timeout=5).read().decode()
     counters, gauges, hists = {}, {}, {}
+    ledger: dict = {}
     types = {}
     for line in text.splitlines():
         if line.startswith("# TYPE "):
@@ -196,6 +267,13 @@ def report_scrape(url: str) -> str:
             continue
         name, _, val = line.partition(" ")
         base = name.split("{")[0]
+        if base == "srjt_compile_ledger":
+            # labeled family: srjt_compile_ledger{plan="...",kind="..."}
+            import re
+            m = re.search(r'plan="([^"]*)".*kind="([^"]*)"', name)
+            if m:
+                ledger.setdefault(m.group(1), {})[m.group(2)] = float(val)
+            continue
         if base.endswith("_sum") and types.get(base[:-4]) == "histogram":
             hists.setdefault(base[:-4], {})["sum"] = float(val)
         elif base.endswith("_count") and types.get(base[:-6]) == "histogram":
@@ -215,6 +293,9 @@ def report_scrape(url: str) -> str:
         if h.get("count"):
             lines.append(f"  {k:<44} n={h['count']:.0f} "
                          f"mean={h['sum'] / h['count']:.3f}")
+    if ledger:
+        lines.append("== compile ledger ==")
+        lines.extend(_ledger_lines(ledger))
     return "\n".join(lines)
 
 

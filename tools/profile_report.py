@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Summarize query-profile artifacts (``plan/profile.py`` JSON exports).
+
+Reads one or more profile files — or a directory of them, e.g. the
+``SRJT_PROFILE_DIR`` a profiled run exported into — flattens the node
+trees, and prints the top-N plan nodes by SELF time (exclusive of
+profiled children) with rows, bytes, est-vs-observed cardinality, engine
+and AQE decisions.  Mispredicted nodes (>2× off the optimizer's prior)
+are flagged: they are the rows worth re-running with fresh stats.
+
+``--regress BASELINE`` compares against an earlier artifact (file or
+directory; node identity = the structural ``node_id`` fingerprint) and
+reports nodes whose self time regressed by more than ``--factor``
+(default 1.5×) — the per-node answer to "which stage got slower".
+
+Usage:
+  python tools/profile_report.py <profile.json|dir> [top_n]
+  python tools/profile_report.py <new> --regress <old> [--factor 1.5]
+
+Exit code: 0, or 3 when --regress found regressions (CI-gateable).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+
+def _paths(arg: str) -> list[str]:
+    if os.path.isdir(arg):
+        return sorted(glob.glob(os.path.join(arg, "profile-*.json")))
+    return [arg]
+
+
+def load_profiles(arg: str) -> list[dict]:
+    out = []
+    for p in _paths(arg):
+        with open(p) as f:
+            out.append(json.load(f))
+    return out
+
+
+def flatten(profiles: list[dict]) -> dict[str, dict]:
+    """node_id → aggregated {count, wall_ms, self_ms, rows, ...}."""
+    agg: dict[str, dict] = {}
+
+    def visit(n: dict) -> None:
+        e = agg.setdefault(n["node_id"], {
+            "line": n.get("line", n.get("op", "?")), "count": 0,
+            "wall_ms": 0.0, "self_ms": 0.0, "fence_ms": 0.0,
+            "out_rows": 0, "out_bytes": 0, "est_rows": None,
+            "mispredict": False, "engine": None, "decisions": []})
+        e["count"] += 1
+        e["wall_ms"] += float(n.get("wall_ms", 0.0))
+        e["self_ms"] += float(n.get("self_ms", 0.0))
+        e["fence_ms"] += float(n.get("fence_ms", 0.0) or 0.0)
+        e["out_rows"] += int(n.get("out_rows") or 0)
+        e["out_bytes"] += int(n.get("out_bytes") or 0)
+        if n.get("est_rows") is not None:
+            e["est_rows"] = n["est_rows"]
+        e["mispredict"] = e["mispredict"] or bool(n.get("mispredict"))
+        if n.get("engine"):
+            e["engine"] = n["engine"]
+        for d in n.get("decisions", ()):
+            if d not in e["decisions"]:
+                e["decisions"].append(d)
+        for c in n.get("children", ()):
+            visit(c)
+
+    for prof in profiles:
+        for root in prof.get("nodes", ()):
+            visit(root)
+    return agg
+
+
+def render(agg: dict[str, dict], top_n: int = 20) -> str:
+    rows = sorted(agg.items(), key=lambda kv: -kv[1]["self_ms"])[:top_n]
+    if not rows:
+        return "(no profiled nodes)"
+    lines = [f"{'self_ms':>9}  {'wall_ms':>9}  {'count':>5}  "
+             f"{'rows':>9}  {'bytes':>11}  node"]
+    for nid, e in rows:
+        flags = []
+        if e["mispredict"]:
+            est = e["est_rows"]
+            flags.append("MISPREDICT"
+                         + (f"(est={est:g})" if est is not None else ""))
+        if e["engine"]:
+            flags.append(f"engine={e['engine']}")
+        suffix = ("   [" + " ".join(flags) + "]") if flags else ""
+        lines.append(f"{e['self_ms']:>9.3f}  {e['wall_ms']:>9.3f}  "
+                     f"{e['count']:>5}  {e['out_rows']:>9}  "
+                     f"{e['out_bytes']:>11}  {e['line']}{suffix}")
+        for d in e["decisions"]:
+            lines.append(" " * 11 + f"fired {d}")
+    return "\n".join(lines)
+
+
+def regressions(new: dict[str, dict], old: dict[str, dict],
+                factor: float) -> list[tuple[str, float, float]]:
+    """Nodes present in both whose mean self time grew > factor×."""
+    out = []
+    for nid, e in new.items():
+        o = old.get(nid)
+        if o is None or not o["count"] or not e["count"]:
+            continue
+        n_mean = e["self_ms"] / e["count"]
+        o_mean = o["self_ms"] / o["count"]
+        if o_mean > 0 and n_mean > factor * o_mean:
+            out.append((e["line"], o_mean, n_mean))
+    return sorted(out, key=lambda r: -(r[2] - r[1]))
+
+
+def main(argv: list[str]) -> int:
+    args = list(argv[1:])
+    factor = 1.5
+    baseline = None
+    if "--factor" in args:
+        i = args.index("--factor")
+        factor = float(args[i + 1])
+        del args[i:i + 2]
+    if "--regress" in args:
+        i = args.index("--regress")
+        baseline = args[i + 1]
+        del args[i:i + 2]
+    if not args:
+        print("usage: profile_report.py <profile.json|dir> [top_n] "
+              "[--regress BASELINE] [--factor F]", file=sys.stderr)
+        return 2
+    profiles = load_profiles(args[0])
+    top_n = int(args[1]) if len(args) > 1 else 20
+    agg = flatten(profiles)
+    total = sum(p.get("wall_ms", 0.0) for p in profiles)
+    mis = sum(1 for e in agg.values() if e["mispredict"])
+    print(f"{args[0]}: {len(profiles)} profile(s), {len(agg)} distinct "
+          f"node(s), wall {total:.2f} ms, {mis} mispredicted")
+    print(render(agg, top_n))
+    for prof in profiles:
+        led = prof.get("compile_ledger")
+        if led:
+            body = "  ".join(f"{k}={led[k]:g}" for k in sorted(led))
+            print(f"\ncompile ledger [{prof.get('fingerprint')}]: {body}")
+    if baseline is not None:
+        old = flatten(load_profiles(baseline))
+        regs = regressions(agg, old, factor)
+        print(f"\nregression check vs {baseline} (> {factor:g}x): "
+              f"{len(regs)} node(s)")
+        for line, o_mean, n_mean in regs:
+            print(f"  {o_mean:.3f} ms → {n_mean:.3f} ms  {line}")
+        if regs:
+            return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -105,6 +105,10 @@ def steady_per_iter(prog, tables, lo=2, hi=6):
 
 
 def main():
+    if "--profile" in sys.argv:
+        # survives the crash-handler os.execv via the env knob
+        sys.argv.remove("--profile")
+        os.environ["SRJT_QB_PROFILE"] = "1"
     n_sales = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
     out_path = sys.argv[2] if len(sys.argv) > 2 else "QUERY_BENCH.json"
     print(f"backend: {jax.default_backend()}  n_sales: {n_sales}", flush=True)
@@ -252,6 +256,33 @@ def main():
                                     tpcds_plans.TABLE_SCHEMAS, tables)
                 except Exception as e:          # noqa: BLE001
                     entry["plan"] = f"explain failed: {e!r}"
+            if knobs.get("SRJT_QB_PROFILE"):
+                # per-plan-node runtime profile (queries with a plan-tree
+                # port): one profiled execution of the optimized tree,
+                # attached as the node-profile dict
+                try:
+                    from spark_rapids_jni_tpu.models import tpcds_plans
+                    from spark_rapids_jni_tpu.plan import lower as plower
+                    from spark_rapids_jni_tpu.plan import \
+                        profile as pprofile
+                    from spark_rapids_jni_tpu.plan import rules as prules
+                    if name in tpcds_plans.PLANS:
+                        ptree = prules.optimize(
+                            tpcds_plans.PLANS[name](),
+                            tpcds_plans.TABLE_SCHEMAS).tree
+                        was_on = pprofile.enabled()
+                        pprofile.set_enabled(True)
+                        try:
+                            with pprofile.query(name) as prof:
+                                plower.execute(
+                                    ptree, plower.TableCatalog(
+                                        tables,
+                                        tpcds_plans.TABLE_SCHEMAS))
+                        finally:
+                            pprofile.set_enabled(was_on)
+                        entry["profile"] = prof.as_dict()
+                except Exception as e:          # noqa: BLE001
+                    entry["profile"] = f"profile failed: {e!r}"
             if use_metrics:
                 snap = metrics.snapshot()
                 entry["stages"] = metrics.stage_breakdown()

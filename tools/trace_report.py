@@ -2,14 +2,24 @@
 """Summarize a Chrome-trace JSON produced by utils/metrics.py.
 
 Aggregates the complete ("ph": "X") span events by name into a top-N table
-(call count, total/max/mean ms, sorted by total time) and prints the
+(call count, total/self/max ms, sorted by SELF time) and prints the
 ``srjtCounters`` registry the exporter rides along — the terminal-side
 answer to "where did this query spend its time" without opening Perfetto.
+
+Spans nest (``compiled.run`` contains ``compiled.dispatch`` contains
+``plan.node:*``), so the table reports both inclusive ``total_ms`` and
+exclusive ``self_ms`` — self-time is computed with a per-(pid,tid) stack
+sweep over the interval tree, so a join span appearing under two stages
+is never double-counted against its parents.
+
+``--by-node`` groups the per-plan-node spans (``plan.node:<Op>`` with a
+``node_id`` arg, emitted while ``SRJT_PROFILE=1``) by node identity
+instead of name — one row per plan node, not per op class.
 
 Works on any Chrome-trace file (object format with ``traceEvents`` or a
 bare event array), so it also digests traces from other tools.
 
-Usage: python tools/trace_report.py <trace.json> [top_n]
+Usage: python tools/trace_report.py <trace.json> [top_n] [--by-node]
 """
 
 from __future__ import annotations
@@ -19,56 +29,106 @@ import sys
 
 
 def load_events(path: str) -> tuple[list[dict], dict]:
-    """→ (trace events, extras dict with srjtCounters/Gauges/Histograms)."""
+    """→ (trace events, extras dict with srjtCounters/Gauges/...)."""
     with open(path) as f:
         doc = json.load(f)
     if isinstance(doc, list):                 # bare event array
         return doc, {}
     events = doc.get("traceEvents", [])
     extras = {k: doc[k] for k in ("srjtCounters", "srjtGauges",
-                                  "srjtHistograms") if k in doc}
+                                  "srjtHistograms", "srjtLedger")
+              if k in doc}
     return events, extras
 
 
-def summarize(events: list[dict]) -> dict[str, dict]:
-    """Aggregate "X" (complete) events by name: count, total/max ms."""
-    agg: dict[str, dict] = {}
-    for ev in events:
+def self_times(events: list[dict]) -> list[float]:
+    """Exclusive duration (µs) for each event, aligned by index.
+
+    Per (pid, tid) lane: sort by (start asc, dur desc) — a parent sorts
+    before the children it contains — and run an enclosing-interval
+    stack.  Each event's duration is subtracted from the innermost
+    enclosing event's self-time, so nested spans never double-count."""
+    lanes: dict[tuple, list[int]] = {}
+    for i, ev in enumerate(events):
         if ev.get("ph") != "X":
             continue
+        lanes.setdefault((ev.get("pid"), ev.get("tid")), []).append(i)
+    selfs = [0.0] * len(events)
+    for idxs in lanes.values():
+        idxs.sort(key=lambda i: (float(events[i].get("ts", 0.0)),
+                                 -float(events[i].get("dur", 0.0))))
+        stack: list[int] = []              # indices of open ancestors
+        for i in idxs:
+            ts = float(events[i].get("ts", 0.0))
+            dur = float(events[i].get("dur", 0.0))
+            while stack:
+                p = stack[-1]
+                p_end = (float(events[p].get("ts", 0.0))
+                         + float(events[p].get("dur", 0.0)))
+                if ts >= p_end:            # sibling, not ancestor
+                    stack.pop()
+                    continue
+                break
+            selfs[i] = dur
+            if stack:
+                selfs[stack[-1]] -= dur
+            stack.append(i)
+    return selfs
+
+
+def summarize(events: list[dict], by_node: bool = False) -> dict[str, dict]:
+    """Aggregate "X" (complete) events: count, total(inclusive)/self/max
+    ms.  ``by_node`` keys plan-node spans by their ``node_id`` arg."""
+    selfs = self_times(events)
+    agg: dict[str, dict] = {}
+    for i, ev in enumerate(events):
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "?")
+        if by_node:
+            args = ev.get("args") or {}
+            if not str(name).startswith("plan.node:"):
+                continue
+            nid = args.get("node_id")
+            name = (args.get("line") or name) if nid is None else \
+                f"{name} [{str(nid)[-12:]}]"
         dur_ms = float(ev.get("dur", 0.0)) / 1e3
-        e = agg.setdefault(ev.get("name", "?"),
-                           {"count": 0, "total_ms": 0.0, "max_ms": 0.0})
+        e = agg.setdefault(name, {"count": 0, "total_ms": 0.0,
+                                  "self_ms": 0.0, "max_ms": 0.0})
         e["count"] += 1
         e["total_ms"] += dur_ms
+        e["self_ms"] += max(selfs[i], 0.0) / 1e3
         e["max_ms"] = max(e["max_ms"], dur_ms)
     return agg
 
 
 def render(agg: dict[str, dict], top_n: int = 20) -> str:
-    rows = sorted(agg.items(), key=lambda kv: -kv[1]["total_ms"])[:top_n]
+    rows = sorted(agg.items(), key=lambda kv: -kv[1]["self_ms"])[:top_n]
     if not rows:
         return "(no span events)"
     w = max((len(name) for name, _ in rows), default=4)
     lines = [f"{'span':<{w}}  {'count':>6}  {'total_ms':>10}  "
-             f"{'mean_ms':>9}  {'max_ms':>9}"]
+             f"{'self_ms':>10}  {'mean_ms':>9}  {'max_ms':>9}"]
     for name, e in rows:
         mean = e["total_ms"] / e["count"] if e["count"] else 0.0
         lines.append(f"{name:<{w}}  {e['count']:>6}  "
-                     f"{e['total_ms']:>10.3f}  {mean:>9.3f}  "
-                     f"{e['max_ms']:>9.3f}")
+                     f"{e['total_ms']:>10.3f}  {e['self_ms']:>10.3f}  "
+                     f"{mean:>9.3f}  {e['max_ms']:>9.3f}")
     return "\n".join(lines)
 
 
 def main(argv: list[str]) -> int:
-    if len(argv) < 2:
+    args = [a for a in argv[1:] if a != "--by-node"]
+    by_node = "--by-node" in argv[1:]
+    if not args:
         print(__doc__.strip().splitlines()[-1], file=sys.stderr)
         return 2
-    path = argv[1]
-    top_n = int(argv[2]) if len(argv) > 2 else 20
+    path = args[0]
+    top_n = int(args[1]) if len(args) > 1 else 20
     events, extras = load_events(path)
-    agg = summarize(events)
-    print(f"{path}: {len(events)} events, {len(agg)} distinct spans")
+    agg = summarize(events, by_node=by_node)
+    print(f"{path}: {len(events)} events, {len(agg)} distinct "
+          f"{'nodes' if by_node else 'spans'}")
     print(render(agg, top_n))
     counters = extras.get("srjtCounters")
     if counters:
@@ -84,6 +144,13 @@ def main(argv: list[str]) -> int:
         w = max(len(k) for k in gauges)
         for k in sorted(gauges):
             print(f"  {k:<{w}}  {gauges[k]}")
+    ledger = extras.get("srjtLedger")
+    if ledger:
+        print("\ncompile ledger:")
+        for plan in sorted(ledger):
+            ent = ledger[plan]
+            body = "  ".join(f"{k}={ent[k]:g}" for k in sorted(ent))
+            print(f"  {plan}: {body}")
     return 0
 
 
