@@ -1,0 +1,127 @@
+#!/usr/bin/env bash
+# ML-handoff smoke — the zero-copy ETL→ML gate: one mini end-to-end pass
+# under the strict sanitizer: synthetic parquet (numerics + a dict-string
+# categorical + a nullable column) → device decode → FeatureSpec pack
+# (bit-identical to the numpy oracle) → fused-epoch training with ZERO
+# steady-loop host syncs → servable registration → predict through the
+# exec/ scheduler bit-identical to direct evaluation, plus an online
+# FeatureView refresh over a delta append.  EXPLAIN ANALYZE must show the
+# ml.pack/ml.predict stages.
+#
+# Usage: ci/ml_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== ml smoke: parquet → features → train → serve =="
+XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+SPARK_RAPIDS_TPU_METRICS=1 \
+SRJT_SANITIZE=strict \
+python - <<'PYEOF'
+import io
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import ml
+from spark_rapids_jni_tpu.plan import ir
+from spark_rapids_jni_tpu.ml import features as F
+from spark_rapids_jni_tpu.parquet import device_scan as decode
+from spark_rapids_jni_tpu.utils import syncs
+
+n = 600
+rng = np.random.default_rng(3)
+cats = ["alpha", "beta", "gamma", "delta"]
+mask = rng.random(n) < 0.2
+buf = io.BytesIO()
+pq.write_table(pa.table({
+    "a": rng.normal(size=n),
+    "b": rng.integers(-50, 50, n),
+    "c": pa.array(np.where(mask, 0, rng.integers(0, 9, n)),
+                  mask=mask, type=pa.int64()),
+    "cat": pa.array([cats[i] for i in rng.integers(0, 4, n)]
+                    ).dictionary_encode(),
+    "label": rng.integers(0, 2, n),
+}), buf)
+blob = buf.getvalue()
+
+names = ["a", "b", "c", "cat", "label"]
+tbl = decode.read_table(blob, columns=names)
+spec = F.FeatureSpec.of(
+    [F.Feature("a"), F.Feature("b"), F.Feature("c", impute="mean"),
+     F.Feature("cat")],
+    label="label", label_transform=("gt", 0.0))
+fb = spec.pack(tbl, names)
+
+# numpy oracle: bit-identical features
+host = pq.read_table(io.BytesIO(blob))
+cvals = host["c"].to_pandas().to_numpy(dtype=np.float64, na_value=np.nan)
+cvalid = ~np.isnan(cvals)
+cmean = np.float32(cvals[cvalid].sum() / cvalid.sum())
+strs = [str(v) for v in host["cat"].to_pylist()]
+rank = {v: i for i, v in enumerate(sorted(set(strs)))}
+oracle = np.stack([
+    np.asarray(host["a"]).astype(np.float32),
+    np.asarray(host["b"]).astype(np.float32),
+    np.where(cvalid, cvals.astype(np.float32), cmean),
+    np.array([rank[v] for v in strs], np.float32),
+], axis=1)
+assert np.array_equal(np.asarray(fb.X), oracle), "feature pack != oracle"
+print(f"pack: {fb.num_rows}x{fb.num_features} bit-identical to oracle")
+
+# fused training: zero steady-loop syncs
+pipe = ml.BatchPipeline(fb, batch_size=64, seed=7)
+tr = ml.Trainer(ml.logistic_regression(), ml.sgd(lr=0.05, momentum=0.9))
+params, ostate = tr.init(pipe.k)
+Xb, yb = pipe.epoch_arrays(0)
+params, ostate, loss = tr.run_epoch(params, ostate, Xb, yb)
+loss.block_until_ready()
+base = syncs.sync_count()
+for e in range(1, 6):
+    Xb, yb = pipe.epoch_arrays(e)
+    params, ostate, loss = tr.run_epoch(params, ostate, Xb, yb)
+assert syncs.sync_count() - base == 0, "steady loop synced the host"
+assert np.isfinite(float(loss)), "training diverged"
+print(f"train: 5 steady epochs, 0 syncs, loss={float(loss):.4f}")
+
+# serve through the scheduler == direct evaluation
+from spark_rapids_jni_tpu import exec as xc
+
+tree = ir.Scan("t")
+sv = ml.ServableModel.from_plan(
+    "smoke", tree, {"t": names},
+    F.FeatureSpec.of([F.Feature("a"), F.Feature("b"),
+                      F.Feature("c", impute="mean"), F.Feature("cat")]),
+    ml.logistic_regression(), params)
+ml.register_servable(sv)
+tables = {"t": tbl}
+direct = sv.predict_table(tables)
+with xc.QueryScheduler(workers=2, devices=2) as sched:
+    served = sched.submit_predict("smoke", tables).result(timeout=120)
+assert np.array_equal(np.asarray(served[0].data),
+                      np.asarray(direct[0].data)), "scheduler != direct"
+print("serve: scheduler prediction bit-identical to direct")
+
+# online feature store: refresh after a delta append re-packs
+from spark_rapids_jni_tpu.stream.delta import DeltaTable
+from spark_rapids_jni_tpu.stream.view import ViewRegistry
+
+dt = DeltaTable("events", files=[blob])
+reg = ViewRegistry(dt, {}, {})
+fv = ml.FeatureView(reg, ir.Scan("events"), spec, name="fv_smoke")
+n0 = fv.current().num_rows
+dt.append_file(blob)
+n1 = fv.refresh().num_rows
+assert n1 == 2 * n0, f"feature view missed the append: {n0} -> {n1}"
+fv.close()
+print(f"feature view: {n0} -> {n1} rows after delta append")
+
+print("ml smoke OK")
+PYEOF

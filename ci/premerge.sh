@@ -68,6 +68,8 @@ if [[ "${1:-}" != "--skip-tests" ]]; then
     ci/bytes_smoke.sh
     echo "== profile smoke (EXPLAIN ANALYZE / per-node profiles) =="
     ci/profile_smoke.sh
+    echo "== ml smoke (ETL→ML handoff) =="
+    ci/ml_smoke.sh
 fi
 
 echo "premerge OK"

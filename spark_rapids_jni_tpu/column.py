@@ -255,6 +255,18 @@ class DictColumn(Column):
     # -- late materialization ------------------------------------------------
     def materialize(self) -> Column:
         """The equivalent plain STRING column (memoized; one size sync)."""
+        if self._mat is not None:
+            # Keep the capture/replay size tape aligned: a column
+            # materialized BEFORE capture would elide this site's scalar
+            # during the capture run, while the traced replay (fresh
+            # tracer-leaf columns, cache empty) still resolves it — the
+            # positional tape would shift and every later size lands at
+            # the wrong site.  Re-recording the cached total restores the
+            # one-scalar-per-materialize invariant in both modes.
+            from .utils import syncs
+            if syncs.mode() != "normal":
+                syncs.scalar(self._mat.offsets[-1])
+            return self._mat
         if self._mat is None:
             from .utils import metrics, syncs
             with metrics.span("strings.dict_materialize",

@@ -413,6 +413,28 @@ class QueryScheduler:
                            priority=priority, timeout_s=timeout_s,
                            nbytes=est, compiled=False, relocatable=False)
 
+    def submit_predict(self, model, tables=None, *,
+                       loader: Optional[Callable[[], Any]] = None,
+                       priority: int = 0,
+                       timeout_s: Optional[float] = None,
+                       nbytes: Optional[int] = None) -> QueryTicket:
+        """Serve an ML servable (``ml/serve.ServableModel`` or its
+        registered name) through the ordinary pipeline: the predict query
+        function runs ``plan → features → jitted predict`` as ONE compiled
+        request, so admission, coalescing, capture/replay and device
+        failover apply exactly as they do to queries.  The result is a
+        one-column f32 prediction Table, bit-identical to
+        ``ServableModel.predict_table`` (asserted in tests, including
+        under injected device faults)."""
+        from ..ml import serve as mlserve
+        sv = mlserve.resolve(model)
+        if metrics.recording():
+            metrics.count("ml.predict.submitted")
+        flight.record("ml.predict.submit", model=sv.name)
+        return self.submit(f"predict:{sv.name}", sv.qfn, tables,
+                           loader=loader, priority=priority,
+                           timeout_s=timeout_s, nbytes=nbytes)
+
     # -- lifecycle -----------------------------------------------------------
 
     def shutdown(self, wait: bool = True) -> None:

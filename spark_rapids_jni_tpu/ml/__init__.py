@@ -1,0 +1,32 @@
+"""Zero-copy ETL→ML handoff.
+
+The CUDA reference exists to feed Spark ETL output into GPU ML (its
+companion demo is mortgage-ETL-into-XGBoost), but it still crosses a
+JVM/host boundary.  Here query outputs and model steps live on the same
+chips in the same JAX process, so a plan's output lowers straight into
+training/inference batches with zero host round-trip:
+
+* :mod:`.features` — ``FeatureSpec`` maps a plan/table's columns to a
+  dense on-device f32 feature matrix (+ optional label vector) through the
+  ``rowconv/`` fixed-width pack path.  Dict-string codes become categorical
+  ids without materializing bytes; nulls resolve through declared
+  imputation policies; every cast happens on-device.
+* :mod:`.pipeline` — epoch/batch iterator slicing device batches from the
+  packed matrix with a deterministic device-side shuffle and zero
+  steady-state host syncs.
+* :mod:`.train` — jitted train-step harness (linear/logistic regression,
+  SGD/Adam) with donated batch buffers, composing with
+  ``models/compiled.py`` capture/replay and the ``SRJT_PROFILE`` ledger.
+* :mod:`.serve` — trained models register as servables; predict requests
+  flow through the ``exec/`` scheduler as ``plan → features → jitted
+  predict``, and ``stream/`` view refresh doubles as an online feature
+  store.
+"""
+
+from .features import (Feature, FeatureBatch, FeatureSpec,  # noqa: F401
+                       compile_feature_plan)
+from .pipeline import BatchPipeline                          # noqa: F401
+from .train import (Trainer, TrainResult, adam,              # noqa: F401
+                    linear_regression, logistic_regression, sgd)
+from .serve import (FeatureView, ServableModel,              # noqa: F401
+                    get_servable, register_servable, servables)

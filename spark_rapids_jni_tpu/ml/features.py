@@ -1,0 +1,269 @@
+"""FeatureSpec: plan/table columns → dense on-device f32 matrix + label.
+
+The JCUDF fixed-width row IS a dense feature matrix (PAPER.md §L1): once
+every feature column is lowered to an all-valid FLOAT32 lane, the
+``rowconv/`` fixed-width pack interleaves them into the row word stream and
+:func:`rowconv.convert.fixed_rows_to_matrix` reinterprets that stream as
+``f32 [n, k]`` — a bitcast plus a slice, no gather, no host round-trip.
+
+Lane lowering contract (mirrored bit-for-bit by the numpy oracle in
+``tests/test_ml.py``):
+
+* ints / dates / timestamps → ``astype(float32)``
+* BOOL8                     → ``(v != 0) → {0.0, 1.0}``
+* DECIMAL32/64 scale s      → ``unscaled.astype(f32) * float32(10.0**s)``
+* FLOAT64                   → exact bit view (``utils.f64bits``) → f32
+* STRING / DictColumn       → ``ops.strings.dictionary_encode`` rank codes
+  (categorical ids; dict inputs re-encode through the dictionary only —
+  row bytes are never materialized).  Ids rank the column's distinct byte
+  strings: for plain strings nulls contribute the zeroed/empty key, for
+  dict columns the dictionary's distinct set is the id space — the two
+  representations agree exactly on null-free columns (differential-tested)
+
+Nulls resolve through declared imputation policies applied AFTER the lane
+cast: ``"zero"``, ``"mean"`` (f64 accumulation on-device), ``("const", v)``,
+or ``"error"`` (reject columns that carry a validity mask).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..column import Column, Table, force_column
+from ..utils import f64bits, knobs, metrics
+
+ImputePolicy = Union[str, tuple]
+
+_CATEGORICAL_IDS = (T.TypeId.STRING,)
+
+
+def _is_categorical(dt: T.DType) -> bool:
+    return dt.id in _CATEGORICAL_IDS
+
+
+@dataclasses.dataclass(frozen=True)
+class Feature:
+    """One feature column: a name plus its null-imputation policy.
+
+    ``impute`` is ``"zero"`` | ``"mean"`` | ``("const", v)`` | ``"error"``
+    (default; a nullable column without a declared policy is a spec error —
+    silent zero-fill has burned every feature store ever built).
+    """
+
+    name: str
+    impute: ImputePolicy = "error"
+
+    def __post_init__(self):
+        p = self.impute
+        if isinstance(p, str):
+            if p not in ("zero", "mean", "error"):
+                raise ValueError(f"feature {self.name!r}: unknown imputation "
+                                 f"policy {p!r}")
+        elif not (isinstance(p, tuple) and len(p) == 2 and p[0] == "const"):
+            raise ValueError(f"feature {self.name!r}: imputation must be "
+                             "'zero' | 'mean' | ('const', v) | 'error'")
+
+
+def _as_feature(f) -> Feature:
+    return f if isinstance(f, Feature) else Feature(str(f))
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class FeatureBatch:
+    """Packed on-device features: ``X`` f32 [n, k], optional ``y`` f32 [n]."""
+
+    X: jnp.ndarray
+    y: Optional[jnp.ndarray] = None
+    feature_names: tuple = ()
+
+    def tree_flatten(self):
+        return (self.X, self.y), self.feature_names
+
+    @classmethod
+    def tree_unflatten(cls, names, children):
+        return cls(children[0], children[1], names)
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.X.shape[0])
+
+    @property
+    def num_features(self) -> int:
+        return int(self.X.shape[1])
+
+
+def _value_lane(col: Column) -> tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Column → (f32 value lane, validity) with zero host materialization."""
+    if _is_categorical(col.dtype):
+        # rank codes == categorical ids; DictColumn re-encodes through its
+        # dictionary (no byte materialization), plain strings pay one
+        # distinct-count sync that rides the syncs tape under capture
+        from ..ops import strings as S
+        codes, _ = S.dictionary_encode(col)
+        return codes.data.astype(jnp.float32), codes.validity
+    col = force_column(col)
+    dt, data = col.dtype, col.data
+    if dt.id == T.TypeId.FLOAT32:
+        lane = data
+    elif dt.id == T.TypeId.FLOAT64:
+        # data is the uint32 [n, 2] bit-pair view; exact bitcast on CPU
+        lane = f64bits.from_bits(data).astype(jnp.float32)
+    elif dt.id == T.TypeId.BOOL8:
+        lane = (data != 0).astype(jnp.float32)
+    elif dt.id in (T.TypeId.DECIMAL32, T.TypeId.DECIMAL64):
+        # np.float32 scale factor: f32 * np.float64 would promote to f64
+        # under the package-global x64 mode
+        lane = data.astype(jnp.float32) * np.float32(10.0 ** dt.scale)
+    elif dt.is_fixed_width and dt.id != T.TypeId.DECIMAL128:
+        lane = data.astype(jnp.float32)
+    else:
+        raise TypeError(f"dtype {dt!r} is not supported as an ML feature")
+    return lane, col.validity
+
+
+def _impute(name: str, lane: jnp.ndarray, valid: Optional[jnp.ndarray],
+            policy: ImputePolicy) -> jnp.ndarray:
+    if valid is None:
+        return lane
+    if policy == "error":
+        raise ValueError(
+            f"feature {name!r} may contain nulls but declares no imputation "
+            "policy — set impute='zero'|'mean'|('const', v)")
+    if policy == "zero":
+        return jnp.where(valid, lane, jnp.float32(0.0))
+    if policy == "mean":
+        # f64 accumulation on-device: exact whenever the lane values are
+        # integers small enough for f64 (the differential tests pin this);
+        # for general float lanes the mean is deterministic-on-device only
+        s = jnp.sum(jnp.where(valid, lane.astype(jnp.float64), 0.0))
+        cnt = jnp.sum(valid.astype(jnp.int64))
+        mean = jnp.where(cnt > 0, s / jnp.maximum(cnt, 1), 0.0)
+        return jnp.where(valid, lane, mean.astype(jnp.float32))
+    return jnp.where(valid, lane, jnp.float32(policy[1]))
+
+
+def _pack_rowconv(lanes: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """All-valid f32 lanes → f32 [n, k] through the JCUDF row stream."""
+    from ..rowconv import convert as RC
+    from ..rowconv.layout import compute_row_layout
+    tbl = Table([Column(T.float32, l) for l in lanes])
+    if tbl.num_rows == 0:
+        return jnp.zeros((0, len(lanes)), jnp.float32)
+    layout = compute_row_layout(tbl.schema)
+    mats = [RC.fixed_rows_to_matrix(b, layout)
+            for b in RC.convert_to_rows(tbl)]
+    return mats[0] if len(mats) == 1 else jnp.concatenate(mats, axis=0)
+
+
+def _pack_stack(lanes: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    return jnp.stack(lanes, axis=1)
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureSpec:
+    """Declarative mapping from named columns to a packed FeatureBatch.
+
+    ``label`` (optional) names the label column; ``label_transform``
+    post-processes the label lane: ``None`` keeps the raw value,
+    ``("gt", t)`` / ``("ge", t)`` binarize to {0.0, 1.0} f32.
+    """
+
+    features: tuple
+    label: Optional[Feature] = None
+    label_transform: Optional[tuple] = None
+
+    @staticmethod
+    def of(features: Sequence, label=None,
+           label_transform: Optional[tuple] = None) -> "FeatureSpec":
+        lab = None if label is None else _as_feature(label)
+        return FeatureSpec(tuple(_as_feature(f) for f in features),
+                           lab, label_transform)
+
+    @property
+    def feature_names(self) -> tuple:
+        return tuple(f.name for f in self.features)
+
+    def _column(self, table: Table, names: Sequence[str], want: str) -> Column:
+        try:
+            return table.columns[list(names).index(want)]
+        except ValueError:
+            raise KeyError(f"column {want!r} not in plan output "
+                           f"{list(names)}") from None
+
+    def _label_lane(self, table: Table, names: Sequence[str]) -> jnp.ndarray:
+        lane, valid = _value_lane(self._column(table, names, self.label.name))
+        lane = _impute(self.label.name, lane, valid, self.label.impute)
+        if self.label_transform is not None:
+            op, t = self.label_transform
+            if op == "gt":
+                lane = (lane > jnp.float32(t)).astype(jnp.float32)
+            elif op == "ge":
+                lane = (lane >= jnp.float32(t)).astype(jnp.float32)
+            else:
+                raise ValueError(f"unknown label transform {op!r}")
+        return lane
+
+    def pack(self, table: Table, names: Optional[Sequence[str]] = None, *,
+             with_label: bool = True, engine: Optional[str] = None
+             ) -> FeatureBatch:
+        """Pack ``table`` into a :class:`FeatureBatch` on-device.
+
+        ``names`` gives the table's column names in order (defaults to the
+        feature order itself when the table was built column-per-feature).
+        """
+        if names is None:
+            names = self.feature_names + (
+                (self.label.name,) if self.label is not None else ())
+        engine = engine or knobs.get("SRJT_ML_PACK")
+        if engine not in ("rowconv", "stack"):
+            raise ValueError(f"SRJT_ML_PACK={engine!r}: want rowconv|stack")
+        with metrics.profile_stage("ml.pack", engine=engine) as rec:
+            lanes = []
+            for f in self.features:
+                lane, valid = _value_lane(self._column(table, names, f.name))
+                lanes.append(_impute(f.name, lane, valid, f.impute))
+            X = (_pack_rowconv if engine == "rowconv" else _pack_stack)(lanes)
+            y = (self._label_lane(table, names)
+                 if with_label and self.label is not None else None)
+            if rec is not None:
+                rec.out_rows = int(X.shape[0])
+                rec.engine = engine
+        if metrics.recording():
+            metrics.count("ml.pack.rows", X.shape[0])
+            metrics.count("ml.pack.features", X.shape[1])
+        return FeatureBatch(X, y, self.feature_names)
+
+
+def compile_feature_plan(tree, schemas: dict, spec: FeatureSpec, *,
+                         with_label: bool = True):
+    """Lower a plan tree to ``tables → FeatureBatch`` (one query function).
+
+    The result composes with ``models.compiled.compile_query`` — the pack
+    path's only data-dependent sync (string distinct count) rides the
+    ``syncs`` tape, so capture/replay works unchanged — and carries
+    ``plan_tree`` / ``plan_fingerprint`` so EXPLAIN ANALYZE and the profile
+    ledger attribute the ML stages to the plan.
+    """
+    from ..plan import lower
+    pqfn = lower.compile_plan(tree, schemas)
+    names = list(getattr(pqfn, "plan_output_names", None)
+                 or lower.output_names(tree, schemas))
+
+    def qfn(tables):
+        return spec.pack(pqfn(tables), names, with_label=with_label)
+
+    qfn.__name__ = "feature_" + getattr(pqfn, "__name__", "plan")
+    qfn.plan_tree = getattr(pqfn, "plan_tree", tree)
+    fp = getattr(pqfn, "plan_fingerprint", None)
+    if fp is not None:
+        qfn.plan_fingerprint = fp + ":ml.features"
+    qfn.plan_output_names = names
+    qfn.feature_spec = spec
+    return qfn

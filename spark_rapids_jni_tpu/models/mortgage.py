@@ -103,6 +103,22 @@ def etl_tables(tables: dict[str, Table]) -> Table:
     return out
 
 
+def feature_spec():
+    """The demo's ETL→ML handoff: every numeric ETL output except the loan
+    id feeds the model; the label is "severely delinquent"
+    (max_delinquency > 2 — the synthetic generator emits delinquency
+    grades 2/3, so >2 is the class split that actually separates).
+    The returned spec packs ``etl_tables`` output straight into the
+    on-device feature matrix — see ``tools/mortgage_bench.py`` for the
+    full parquet→trained-model path."""
+    from ..ml.features import Feature, FeatureSpec
+    feats = [c for c in FEATURE_COLS
+             if c not in ("loan_id", "max_delinquency")]
+    return FeatureSpec.of([Feature(c, impute="mean") for c in feats],
+                          label="max_delinquency",
+                          label_transform=("gt", 2.0))
+
+
 def feature_matrix(files: dict[str, bytes]):
     """Feature table → dense float32 [n_loans, n_features-1] + loan ids —
     the XGBoost handoff (everything numeric, nulls already absorbed)."""

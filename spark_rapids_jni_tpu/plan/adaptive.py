@@ -465,6 +465,7 @@ def compile_adaptive_plan(tree: ir.Plan, schemas: dict):
 
     qfn.plan_tree = tree
     qfn.plan_fingerprint = ir.fingerprint(tree)
+    qfn.plan_output_names = lower.output_names(tree, schemas)
     qfn.aqe_variant = "aqe"
     qfn.last_report = None
     return qfn

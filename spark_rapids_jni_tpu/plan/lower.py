@@ -466,4 +466,7 @@ def compile_plan(tree: ir.Plan, schemas: dict):
 
     qfn.plan_tree = tree
     qfn.plan_fingerprint = ir.fingerprint(tree)
+    # output column names, in order — consumers that bind columns by name
+    # (ml/ FeatureSpec packing) read these instead of re-deriving the schema
+    qfn.plan_output_names = output_names(tree, schemas)
     return qfn

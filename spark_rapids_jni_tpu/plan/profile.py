@@ -359,6 +359,41 @@ def annotate_node(engine: Optional[str] = None,
             {"op": "annotate", k: v})
 
 
+@contextlib.contextmanager
+def stage(name: str, **fields):
+    """Open a synthetic node record for a non-plan stage (ml/ feature
+    pack, train-step, predict): the stage gets its own row in EXPLAIN
+    ANALYZE / profile_report with wall/self time, and :func:`op_event`s
+    fired inside attach to it.  Installed as
+    ``metrics._profile_stage_hook`` — ml/ reaches it without importing
+    plan/ (same discipline as :func:`op_event`)."""
+    if not _enabled:
+        yield None
+        return
+    prof = getattr(_tls, "prof", None)
+    if prof is None or syncs.mode() == "replay":
+        yield None
+        return
+    line = name if not fields else name + "(" + ", ".join(
+        f"{k}={v}" for k, v in fields.items()) + ")"
+    rec = NodeProfile(op=name, line=line, node_id=name)
+    prof._stack.append(rec)
+    rec._t0 = time.perf_counter()
+    try:
+        yield rec
+    except BaseException:
+        rec.error = True
+        raise
+    finally:
+        rec.wall_ms = (time.perf_counter() - rec._t0) * 1e3
+        if prof._stack and prof._stack[-1] is rec:
+            prof._stack.pop()
+            if prof._stack:
+                prof._stack[-1].children.append(rec)
+            else:
+                prof.roots.append(rec)
+
+
 def op_event(name: str, **fields) -> None:
     """One op-level event (join match counts, filter selectivity, scan
     pruning, rowconv volumes) into the innermost open node record.
@@ -589,3 +624,4 @@ flight.register_probe("plan.active_profile", _flight_probe)
 # ``metrics.profile_op`` — installing the hook here keeps plan/ out of
 # their import graphs entirely
 metrics._profile_op_hook = op_event
+metrics._profile_stage_hook = stage

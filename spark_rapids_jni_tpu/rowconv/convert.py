@@ -1017,6 +1017,31 @@ def _slice_column(col: Column, lo: int, hi: int) -> Column:
     return Column(col.dtype, col.data[lo:hi], validity=v)
 
 
+# --- fixed-width rows as a dense feature matrix (ml/ handoff) ---------------
+
+
+def fixed_rows_to_matrix(batch: RowBatch, layout: RowLayout) -> jnp.ndarray:
+    """JCUDF fixed-width rows of an all-FLOAT32 schema → dense f32 [n, k].
+
+    The JCUDF fixed-width row IS a dense feature matrix (PAPER.md §L1): for
+    an all-f32 schema the k data slots sit at consecutive 4-byte offsets
+    0,4,…,4(k-1), so the matrix is a pure reinterpretation of the row word
+    stream — reshape to [n, row_words], slice the k leading words, bitcast
+    to f32.  No gather, no arithmetic, no host sync; values are bit-identical
+    to the source columns by construction.
+    """
+    if not layout.fixed_width_only:
+        raise ValueError("fixed_rows_to_matrix requires a fixed-width layout")
+    if any(dt.id != T.TypeId.FLOAT32 for dt in layout.schema):
+        raise ValueError("fixed_rows_to_matrix requires an all-FLOAT32 schema")
+    k = layout.num_columns
+    W = layout.fixed_row_size // 4
+    words = (batch.data if batch.data.dtype == jnp.uint32
+             else _bytes_to_words(batch.data))
+    m = words.reshape(-1, W)[:, :k]
+    return jax.lax.bitcast_convert_type(m, jnp.float32)
+
+
 # --- dictionary-codes passthrough (dict string fast path) -------------------
 #
 # A DictColumn reaching convert_to_rows materializes its bytes — correct

@@ -102,6 +102,7 @@ class ViewRegistry:
         self._mu = sanitize.tracked_lock("stream.view_registry")
         self._by_fp: dict[str, MaterializedView] = {}
         self._by_name: dict[str, MaterializedView] = {}
+        self._listeners: list = []
         self._fallbacks = 0
         self._probe = f"stream.views:{delta.name}"
         flight.register_probe(self._probe, self.stats)
@@ -257,10 +258,38 @@ class ViewRegistry:
 
     # -- refresh ------------------------------------------------------------
 
+    def add_refresh_listener(self, fn) -> None:
+        """Register ``fn(view, table)`` to run after every successful
+        refresh, OUTSIDE the view's refresh lock (the online-feature-store
+        hook — ``ml/serve.FeatureView`` re-packs here).  Listener errors
+        are recorded to the flight buffer, never propagated into refresh."""
+        with self._mu:
+            self._listeners.append(fn)
+
+    def remove_refresh_listener(self, fn) -> None:
+        with self._mu:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    def _notify_refresh(self, v: MaterializedView, table: Table) -> None:
+        with self._mu:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(v, table)
+            except Exception as e:                     # noqa: BLE001
+                flight.record("stream.refresh.listener_error",
+                              view=v.name, error=repr(e))
+
     def refresh(self, view) -> Table:
         """Bring the view up to the fact table's current epoch and return
         its result (post-aggregate Sort/Filter/Limit applied)."""
         v = self.resolve(view)
+        out = self._refresh_locked(v)
+        self._notify_refresh(v, out)
+        return out
+
+    def _refresh_locked(self, v: MaterializedView) -> Table:
         with v.lock:
             with metrics.span("stream.refresh", view=v.name, kind=v.kind):
                 v.refreshes += 1

@@ -364,6 +364,27 @@ register("SRJT_PALLAS_TRANSPOSE", "0", _str,
          "on TPU, `interpret` forces interpreter mode (CI parity), "
          "default off → strided lax transpose", "parquet")
 
+# ml handoff (ml/)
+register("SRJT_ML_PACK", "rowconv", _str,
+         "feature-pack engine: `rowconv` reinterprets the JCUDF fixed-width "
+         "row stream as the feature matrix (zero-copy), `stack` is the "
+         "reference lane-stack A/B", "ml")
+register("SRJT_ML_BATCH", "256", _int,
+         "default minibatch size for `ml.pipeline.BatchPipeline`", "ml")
+register("SRJT_ML_SEED", "0", _int,
+         "default PRNG seed for the device-side epoch shuffle", "ml")
+register("SRJT_ML_SHUFFLE", "feistel", _str,
+         "epoch-shuffle engine: `feistel` is the sort-free O(n) Feistel "
+         "bijection, `sort` is `jax.random.permutation` (single-threaded "
+         "O(n log n) sort on XLA:CPU) kept as the cross-check", "ml")
+register("SRJT_ML_EPOCH_FUSE", "1", _on_unless_0_off,
+         "fuse each training epoch into one jitted `lax.scan` dispatch; "
+         "`0`/`off` dispatches per-batch steps", "ml")
+register("SRJT_ML_DONATE", "auto", _str,
+         "donate minibatch buffers into the jitted train step/epoch "
+         "(`1`/`on`, `0`/`off`, `auto` = on for non-CPU backends where "
+         "XLA implements donation)", "ml")
+
 # streaming
 register("SRJT_STREAM_ALLOW_APPROX", "0", _opt_in,
          "allow approximate incremental states (`1`/`true`/`on` only)",
@@ -409,6 +430,7 @@ _SECTION_TITLES = {
     "rowconv": "Row conversion (`rowconv/`)",
     "plan": "Plan optimizer (`plan/`)",
     "parquet": "Parquet scan (`parquet/`)",
+    "ml": "ML handoff (`ml/`)",
     "stream": "Streaming (`stream/`)",
     "tools": "Tools & benches",
     "general": "General",

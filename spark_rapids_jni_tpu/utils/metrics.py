@@ -124,6 +124,21 @@ def profile_op(name: str, **fields) -> None:
         hook(name, **fields)
 
 
+_profile_stage_hook = None      # plan/profile.stage once that module loads
+
+
+def profile_stage(name: str, **fields):
+    """Context manager opening a synthetic stage record (ml/ feature pack,
+    train, predict) under the active plan-node profile — the non-plan-node
+    twin of :func:`profile_op`, same no-import-cycle indirection.  Yields
+    the open record (or None when no profile is active) so the stage can
+    set output facts like ``out_rows``."""
+    hook = _profile_stage_hook
+    if hook is None:
+        return contextlib.nullcontext()
+    return hook(name, **fields)
+
+
 # --- compile-cost ledger -----------------------------------------------------
 
 

@@ -1,0 +1,476 @@
+"""Differential suite for the zero-copy ETL→ML handoff (``ml/``).
+
+The contracts under test, each against an independent host oracle:
+
+* feature pack bit-identity — every lane (ints, decimals, f64 bit-pairs,
+  bool, dict-string categoricals, null imputation) must match a numpy
+  oracle BIT FOR BIT, through both pack engines (``rowconv`` row-stream
+  reinterpretation and the ``stack`` reference);
+* categorical ids without byte materialization — a DictColumn feature
+  packs through its dictionary only (``strings.dict.materialize`` == 0);
+* train-step parity — the jitted SGD/Adam steps against a float32 numpy
+  reference fed the identical shuffled batches;
+* zero steady-state syncs — after one warm epoch, N further epochs
+  dispatch with ``syncs.sync_count()`` delta of exactly zero;
+* capture/replay — a feature plan compiled via ``models/compiled.py``
+  replays bit-identically (the pack path's one data-dependent sync rides
+  the tape);
+* predict-through-scheduler bit-identity — including under one injected
+  device fault (PR 11 chaos harness);
+* online feature store — a FeatureView re-packed by delta refresh equals
+  a from-scratch pack of the refreshed view result.
+"""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import exec as xc
+from spark_rapids_jni_tpu import ml
+from spark_rapids_jni_tpu import types as T
+from spark_rapids_jni_tpu.column import Column, DictColumn, Table
+from spark_rapids_jni_tpu.faultinj import injector as finj
+from spark_rapids_jni_tpu.ml import features as F
+from spark_rapids_jni_tpu.models import compiled as C
+from spark_rapids_jni_tpu.plan import ir
+from spark_rapids_jni_tpu.stream import DeltaTable, ViewRegistry
+from spark_rapids_jni_tpu.utils import metrics, syncs
+
+
+@pytest.fixture(autouse=True)
+def _metrics_on():
+    metrics.set_enabled(True)
+    metrics.reset()
+    yield
+    finj.get_injector().disable()
+    metrics.reset()
+    metrics.set_enabled(None)
+
+
+def _np32(x):
+    return np.asarray(x, dtype=np.float32)
+
+
+def _categorical_oracle(values, impute=-1.0):
+    """The documented categorical-id contract: rank among the sorted
+    distinct byte strings, where null rows contribute the zeroed (empty)
+    key; imputation applies after encoding."""
+    distinct = set(v for v in values if v is not None)
+    if any(v is None for v in values):
+        distinct.add("")
+    rank = {v: i for i, v in enumerate(sorted(distinct))}
+    return np.array([impute if v is None else rank[v] for v in values],
+                    dtype=np.float32)
+
+
+# --- feature pack bit-identity ----------------------------------------------
+
+
+class TestFeaturePack:
+    def _mixed(self, n=257, seed=0):
+        rng = np.random.default_rng(seed)
+        i64 = rng.integers(-1000, 1000, n).astype(np.int64)
+        i32 = rng.integers(0, 100, n).astype(np.int32)
+        i32_null = rng.random(n) < 0.25
+        f64 = rng.normal(size=n) * 1e3
+        f32 = rng.normal(size=n).astype(np.float32)
+        b8 = rng.integers(0, 2, n).astype(bool)
+        dec = rng.integers(-10**6, 10**6, n).astype(np.int64)
+        strs = [None if rng.random() < 0.2
+                else ["red", "green", "blue", "", "aa\x00b"][
+                    rng.integers(0, 5)] for _ in range(n)]
+        tbl = Table([
+            Column.from_numpy(i64),
+            Column(T.int32, jnp.asarray(i32),
+                   validity=jnp.asarray(~i32_null)),
+            Column.from_numpy(f64),
+            Column(T.float32, jnp.asarray(f32)),
+            Column.from_numpy(b8),
+            Column(T.decimal64(-3), jnp.asarray(dec)),
+            Column.strings_from_list(strs),
+        ])
+        names = ["i64", "i32", "f64", "f32", "b8", "dec", "s"]
+        host = dict(i64=i64, i32=i32, i32_null=i32_null, f64=f64, f32=f32,
+                    b8=b8, dec=dec, strs=strs)
+        return tbl, names, host
+
+    def _oracle(self, host):
+        i32 = host["i32"].astype(np.float32)
+        null = host["i32_null"]
+        # mean imputation: f64 accumulation over the valid int values —
+        # exact, order-independent
+        mean = np.float32(host["i32"][~null].astype(np.float64).mean())
+        i32 = np.where(null, mean, i32).astype(np.float32)
+        return np.stack([
+            host["i64"].astype(np.float32),
+            i32,
+            host["f64"].astype(np.float64).astype(np.float32),
+            host["f32"],
+            host["b8"].astype(np.float32),
+            host["dec"].astype(np.float32) * np.float32(10.0 ** -3),
+            _categorical_oracle(host["strs"]),
+        ], axis=1)
+
+    def _spec(self):
+        return F.FeatureSpec.of([
+            F.Feature("i64"), F.Feature("i32", impute="mean"),
+            F.Feature("f64"), F.Feature("f32"), F.Feature("b8"),
+            F.Feature("dec"), F.Feature("s", impute=("const", -1.0)),
+        ])
+
+    def test_bit_identical_to_numpy_oracle(self):
+        tbl, names, host = self._mixed()
+        fb = self._spec().pack(tbl, names)
+        assert fb.X.dtype == jnp.float32
+        np.testing.assert_array_equal(_np32(fb.X), self._oracle(host))
+
+    def test_engines_bit_identical(self):
+        tbl, names, _ = self._mixed(seed=3)
+        spec = self._spec()
+        a = spec.pack(tbl, names, engine="rowconv")
+        b = spec.pack(tbl, names, engine="stack")
+        np.testing.assert_array_equal(_np32(a.X), _np32(b.X))
+
+    def test_multi_batch_rowconv_pack(self):
+        # tiny batch cap forces >1 RowBatch through the matrix reslice
+        n = 300
+        rng = np.random.default_rng(7)
+        vals = rng.normal(size=(n, 3)).astype(np.float32)
+        tbl = Table([Column(T.float32, jnp.asarray(vals[:, i]))
+                     for i in range(3)])
+        from spark_rapids_jni_tpu.rowconv import convert as RC
+        from spark_rapids_jni_tpu.rowconv.layout import compute_row_layout
+        layout = compute_row_layout(tbl.schema)
+        batches = RC.convert_to_rows(tbl, max_batch_bytes=
+                                     layout.fixed_row_size * 64)
+        assert len(batches) > 1
+        mats = [RC.fixed_rows_to_matrix(b, layout) for b in batches]
+        np.testing.assert_array_equal(
+            _np32(jnp.concatenate(mats, axis=0)), vals)
+
+    def test_dict_categorical_never_materializes(self):
+        # dict-path id contract: rank over the DICTIONARY's distinct
+        # values (nulls collapse onto code 0 but impute away); the
+        # plain-string path additionally ranks the null/zeroed key —
+        # the two representations agree exactly on null-free columns
+        strs = ["b", "a", "c", "a", None, "b"] * 40
+        codes = jnp.asarray(np.array([1, 0, 2, 0, 0, 1] * 40, np.int32))
+        dcol = DictColumn(codes, Column.strings_from_list(["a", "b", "c"]),
+                          validity=jnp.asarray(
+                              np.array([s is not None for s in strs])))
+        spec = F.FeatureSpec.of([F.Feature("s", impute=("const", -1.0))])
+        before = metrics.counter_value("strings.dict.materialize")
+        fb = spec.pack(Table([dcol]), ["s"])
+        assert metrics.counter_value("strings.dict.materialize") == before
+        rank = {"a": 0.0, "b": 1.0, "c": 2.0}
+        np.testing.assert_array_equal(
+            _np32(fb.X)[:, 0],
+            np.array([-1.0 if s is None else rank[s] for s in strs],
+                     np.float32))
+
+    def test_dict_and_plain_paths_agree_when_null_free(self):
+        strs = ["b", "a", "c", "a", "c", "b"] * 40
+        codes = jnp.asarray(np.array([1, 0, 2, 0, 2, 1] * 40, np.int32))
+        dcol = DictColumn(codes, Column.strings_from_list(["a", "b", "c"]))
+        spec = F.FeatureSpec.of([F.Feature("s")])
+        a = spec.pack(Table([dcol]), ["s"])
+        b = spec.pack(Table([Column.strings_from_list(strs)]), ["s"])
+        np.testing.assert_array_equal(_np32(a.X), _np32(b.X))
+        np.testing.assert_array_equal(_np32(a.X)[:, 0],
+                                      _categorical_oracle(strs))
+
+    def test_imputation_policies(self):
+        vals = np.array([1, -2, 3, 4, 5], np.int64)
+        valid = np.array([True, False, True, False, True])
+        col = Column(T.int64, jnp.asarray(vals), validity=jnp.asarray(valid))
+        for policy, fill in (("zero", 0.0), (("const", 9.5), 9.5)):
+            fb = F.FeatureSpec.of([F.Feature("v", impute=policy)]).pack(
+                Table([col]), ["v"])
+            oracle = np.where(valid, vals.astype(np.float32),
+                              np.float32(fill))
+            np.testing.assert_array_equal(_np32(fb.X)[:, 0], oracle)
+        fb = F.FeatureSpec.of([F.Feature("v", impute="mean")]).pack(
+            Table([col]), ["v"])
+        mean = np.float32(vals[valid].astype(np.float64).mean())
+        np.testing.assert_array_equal(
+            _np32(fb.X)[:, 0],
+            np.where(valid, vals.astype(np.float32), mean))
+
+    def test_nullable_without_policy_is_an_error(self):
+        col = Column(T.int64, jnp.asarray(np.arange(4)),
+                     validity=jnp.asarray([True, False, True, True]))
+        with pytest.raises(ValueError, match="imputation"):
+            F.FeatureSpec.of([F.Feature("v")]).pack(Table([col]), ["v"])
+
+    def test_label_binarization(self):
+        y = np.array([0, 1, 3, 0, 2], np.int64)
+        tbl = Table([Column.from_numpy(np.arange(5, dtype=np.int64)),
+                     Column.from_numpy(y)])
+        spec = F.FeatureSpec.of([F.Feature("x")], label="d",
+                                label_transform=("gt", 0.0))
+        fb = spec.pack(tbl, ["x", "d"])
+        np.testing.assert_array_equal(_np32(fb.y),
+                                      (y > 0).astype(np.float32))
+        # serving packs features-only from the same spec
+        fb2 = spec.pack(Table([tbl[0]]), ["x"], with_label=False)
+        assert fb2.y is None and fb2.X.shape == (5, 1)
+
+
+# --- train-step parity vs numpy ---------------------------------------------
+
+
+def _numpy_sgd_logreg(batches, lr, epochs_batches):
+    """float32 numpy reference of the jitted logistic/SGD step."""
+    k = batches[0][0].shape[1]
+    w = np.zeros(k, np.float32)
+    b = np.float32(0.0)
+    vw = np.zeros(k, np.float32)
+    vb = np.float32(0.0)
+    mu = np.float32(0.9)
+    lr = np.float32(lr)
+    for xb, yb in batches:
+        z = xb @ w + b
+        p = 1.0 / (1.0 + np.exp(-z.astype(np.float64)))
+        g = (p.astype(np.float32) - yb) / np.float32(xb.shape[0])
+        gw = xb.T @ g
+        gb = g.sum(dtype=np.float32)
+        vw = mu * vw + gw
+        vb = mu * vb + gb
+        w = w - lr * vw
+        b = b - lr * vb
+    return w, b
+
+
+class TestTrainParity:
+    def _pipe(self, n=512, k=3, seed=4, batch=64):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, k)).astype(np.float32)
+        y = (X @ rng.normal(size=k).astype(np.float32) > 0).astype(
+            np.float32)
+        fb = F.FeatureBatch(jnp.asarray(X), jnp.asarray(y))
+        return ml.BatchPipeline(fb, batch_size=batch, seed=seed)
+
+    def test_logreg_sgd_matches_numpy(self):
+        pipe = self._pipe()
+        tr = ml.Trainer(ml.logistic_regression(), ml.sgd(lr=0.3,
+                                                         momentum=0.9))
+        params, ostate = tr.init(pipe.k)
+        host_batches = []
+        for e in range(3):
+            Xb, yb = pipe.epoch_arrays(e)
+            host_batches += [(np.asarray(Xb[i]), np.asarray(yb[i]))
+                             for i in range(pipe.num_batches)]
+            params, ostate, _ = tr.run_epoch(params, ostate, Xb, yb)
+        w, b = _numpy_sgd_logreg(host_batches, 0.3, None)
+        np.testing.assert_allclose(np.asarray(params["w"]), w,
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(float(params["b"]), b,
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_adam_linreg_converges_and_matches_reference(self):
+        pipe = self._pipe(seed=9)
+        tr = ml.Trainer(ml.linear_regression(), ml.adam(lr=0.05))
+        res = tr.fit(pipe, epochs=12)
+        assert res.losses[-1] < res.losses[0]
+        # rerunning from scratch is deterministic
+        res2 = ml.Trainer(ml.linear_regression(),
+                          ml.adam(lr=0.05)).fit(pipe, epochs=12)
+        np.testing.assert_array_equal(res.losses, res2.losses)
+        np.testing.assert_array_equal(np.asarray(res.params["w"]),
+                                      np.asarray(res2.params["w"]))
+
+    def test_fused_and_unfused_epochs_agree(self):
+        pipe = self._pipe(seed=11, batch=128)
+        a = ml.Trainer(ml.logistic_regression(), ml.sgd(lr=0.1),
+                       fuse=True).fit(pipe, epochs=2)
+        b = ml.Trainer(ml.logistic_regression(), ml.sgd(lr=0.1),
+                       fuse=False).fit(pipe, epochs=2)
+        np.testing.assert_allclose(np.asarray(a.params["w"]),
+                                   np.asarray(b.params["w"]),
+                                   rtol=1e-6, atol=1e-7)
+
+
+# --- the zero-sync steady loop ----------------------------------------------
+
+
+class TestSteadyLoop:
+    def test_zero_syncs_across_steady_epochs(self):
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(1024, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        pipe = ml.BatchPipeline(
+            F.FeatureBatch(jnp.asarray(X), jnp.asarray(y)),
+            batch_size=128, seed=1)
+        tr = ml.Trainer(ml.logistic_regression(), ml.adam(lr=0.01))
+        params, ostate = tr.init(pipe.k)
+        Xb, yb = pipe.epoch_arrays(0)           # warm epoch compiles
+        params, ostate, loss = tr.run_epoch(params, ostate, Xb, yb)
+        loss.block_until_ready()
+        base = syncs.sync_count()
+        for e in range(1, 5):
+            Xb, yb = pipe.epoch_arrays(e)
+            params, ostate, loss = tr.run_epoch(params, ostate, Xb, yb)
+        assert syncs.sync_count() - base == 0, \
+            "steady batch loop must not sync the host"
+        assert np.isfinite(float(loss))
+
+    def test_shuffle_is_deterministic_per_epoch(self):
+        X = jnp.asarray(np.arange(40, dtype=np.float32).reshape(20, 2))
+        y = jnp.zeros(20, jnp.float32)
+        p1 = ml.BatchPipeline(F.FeatureBatch(X, y), batch_size=5, seed=42)
+        p2 = ml.BatchPipeline(F.FeatureBatch(X, y), batch_size=5, seed=42)
+        a, b = p1.epoch_arrays(3), p2.epoch_arrays(3)
+        np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+        c = p1.epoch_arrays(4)
+        assert not np.array_equal(np.asarray(a[0]), np.asarray(c[0]))
+        # every epoch visits a permutation: sorted rows == sorted input
+        np.testing.assert_array_equal(
+            np.sort(np.asarray(a[0]).reshape(20, 2), axis=0),
+            np.sort(np.asarray(X), axis=0))
+
+    def test_both_shuffle_engines_are_permutations(self):
+        n = 203                       # odd size exercises feistel compaction
+        X = jnp.asarray(np.arange(n, dtype=np.float32).reshape(n, 1))
+        y = jnp.zeros(n, jnp.float32)
+        for eng in ("feistel", "sort"):
+            pipe = ml.BatchPipeline(F.FeatureBatch(X, y), batch_size=n,
+                                    seed=9, shuffle=eng)
+            rows = np.asarray(pipe.epoch_arrays(2)[0]).reshape(n)
+            assert not np.array_equal(rows, np.arange(n)), eng
+            np.testing.assert_array_equal(np.sort(rows), np.arange(n),
+                                          err_msg=eng)
+
+
+# --- capture/replay ----------------------------------------------------------
+
+
+class TestCaptureReplay:
+    def test_feature_plan_roundtrip(self):
+        n = 200
+        rng = np.random.default_rng(5)
+        strs = [["x", "y", "zz", None][i % 4] for i in range(n)]
+        tbl = Table([Column.from_numpy(
+                         rng.integers(0, 9, n).astype(np.int32)),
+                     Column.strings_from_list(strs)])
+        tables = {"t": tbl}
+        spec = F.FeatureSpec.of([F.Feature("a"),
+                                 F.Feature("s", impute=("const", -1.0))])
+        tree = ir.Filter(ir.Scan("t"),
+                         ir.Cmp(">", ir.Col("a"), ir.Lit(2)))
+        qfn = F.compile_feature_plan(tree, {"t": ["a", "s"]}, spec,
+                                     with_label=False)
+        assert qfn.plan_fingerprint.endswith(":ml.features")
+        eager = qfn(tables)
+        cq = C.compile_query(qfn, tables)
+        for _ in range(2):
+            got = cq.run(tables)
+            np.testing.assert_array_equal(_np32(eager.X), _np32(got.X))
+
+
+# --- predict through the scheduler ------------------------------------------
+
+
+def _servable(seed=1, n=512):
+    rng = np.random.default_rng(seed)
+    tbl = Table([Column.from_numpy(
+                     rng.integers(0, 50, n).astype(np.int64)),
+                 Column(T.float32, jnp.asarray(
+                     rng.normal(size=n).astype(np.float32)))])
+    tables = {"t": tbl}
+    spec = F.FeatureSpec.of([F.Feature("a"), F.Feature("b")])
+    params = {"w": jnp.asarray(rng.normal(size=2).astype(np.float32)),
+              "b": jnp.float32(0.25)}
+    sv = ml.ServableModel.from_plan(f"sv{seed}", ir.Scan("t"),
+                                    {"t": ["a", "b"]}, spec,
+                                    ml.logistic_regression(), params)
+    return sv, tables
+
+
+class TestServe:
+    def test_predict_through_scheduler_bit_identical(self):
+        sv, tables = _servable(seed=21)
+        ml.register_servable(sv)
+        assert sv.name in ml.servables()
+        oracle = np.asarray(sv.predict_table(tables)[0].data)
+        with xc.QueryScheduler(workers=2, devices=2) as sched:
+            got = [sched.submit_predict(sv.name, tables).result(timeout=60)
+                   for _ in range(4)]
+        for t in got:
+            np.testing.assert_array_equal(np.asarray(t[0].data), oracle)
+
+    def test_predict_bit_identical_under_device_fault(self):
+        sv, tables = _servable(seed=22)
+        oracle = np.asarray(sv.predict_table(tables)[0].data)
+        inj = finj.get_injector()
+        assert len(jax.devices()) >= 4
+        with xc.QueryScheduler(workers=4, devices=4, probe_base_s=0.02,
+                               probe_max_s=0.2) as sched:
+            inj.load_dict({"seed": 1, "sites": {
+                "exec.dispatch": {"percent": 100,
+                                  "injectionType": "device_error",
+                                  "maxHits": 1}}})
+            inj.enable()
+            tickets = [sched.submit_predict(sv, tables) for _ in range(8)]
+            for tk in tickets:
+                np.testing.assert_array_equal(
+                    np.asarray(tk.result(timeout=120)[0].data), oracle)
+            assert inj.injected_count == 1
+            assert any(tk.relocations > 0 for tk in tickets), \
+                "no predict request failed over"
+
+
+# --- online feature store ----------------------------------------------------
+
+
+def _blob(n, start=0):
+    tab = pa.table({
+        "k": pa.array(np.arange(start, start + n, dtype=np.int32)),
+        "v": pa.array((np.arange(start, start + n) * 3).astype(np.int64)),
+    })
+    buf = io.BytesIO()
+    pq.write_table(tab, buf, row_group_size=4, use_dictionary=False)
+    return buf.getvalue()
+
+
+class TestFeatureView:
+    def test_online_refresh_matches_full_recompute(self):
+        delta = DeltaTable("f", files=[_blob(16)])
+        reg = ViewRegistry(delta, {}, {})
+        plan = ir.Aggregate(ir.Scan("f"), ("k",),
+                            (("v", "sum", "sv"), ("v", "count", "nv")))
+        spec = F.FeatureSpec.of([F.Feature("k"), F.Feature("sv")],
+                                label="nv")
+        fv = ml.FeatureView(reg, plan, spec)
+        try:
+            assert fv.current().num_rows == 16
+            for start in (100, 200):
+                delta.append_file(_blob(8, start=start))
+                fb = fv.refresh()
+                oracle = spec.pack(reg.refresh(fv.view), fv.names)
+                np.testing.assert_array_equal(_np32(fb.X), _np32(oracle.X))
+                np.testing.assert_array_equal(_np32(fb.y), _np32(oracle.y))
+            assert metrics.counter_value("stream.refresh.incremental") >= 2
+            assert metrics.counter_value("ml.feature_view.repacks") >= 3
+        finally:
+            fv.close()
+
+    def test_refresh_through_scheduler_repacks(self):
+        delta = DeltaTable("f", files=[_blob(12)])
+        reg = ViewRegistry(delta, {}, {})
+        plan = ir.Aggregate(ir.Scan("f"), ("k",), (("v", "sum", "sv"),))
+        spec = F.FeatureSpec.of([F.Feature("k"), F.Feature("sv")])
+        fv = ml.FeatureView(reg, plan, spec, with_label=False)
+        try:
+            fv.refresh()
+            delta.append_file(_blob(6, start=500))
+            with xc.QueryScheduler(workers=1, devices=1) as sched:
+                sched.submit_refresh(reg, fv.view).result(timeout=60)
+            assert fv.current().num_rows == 18
+        finally:
+            fv.close()
