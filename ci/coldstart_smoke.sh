@@ -1,0 +1,140 @@
+#!/usr/bin/env bash
+# Zero-compile cold-start smoke (exec/artifacts.py) — the two-process
+# runbook, asserted end to end: process A serves a TPC-DS mix against an
+# empty SRJT_AOT_DIR and populates the plan-artifact store (capture tapes
+# + warm-up manifest + the XLA executable cache); process B — a FRESH
+# interpreter — serves the SAME mix and must perform ZERO capture runs
+# (compiled.capture == 0 in the ledger snapshot, every plan rehydrated
+# from its persisted tape) with results bit-identical to A's; process C
+# re-serves after an artifact file is deliberately corrupted and must
+# DEGRADE to live capture (aot.reject counted, results still identical) —
+# never fail.  Artifacts land in target/coldstart_smoke/.
+#
+# Usage: ci/coldstart_smoke.sh [n_sales] [queries]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N_SALES="${1:-50000}"
+QUERIES="${2:-q3,q42,q55}"
+OUT=target/coldstart_smoke
+AOT="$OUT/aot"
+rm -rf "$OUT"
+mkdir -p "$OUT"
+
+cat > "$OUT/serve_once.py" <<'PYEOF'
+"""One fresh serving process over the smoke mix: serve each query through
+the full QueryScheduler, dump result hashes + compile-ledger counters."""
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+import jax
+
+from benchmarks import tpcds_data
+from spark_rapids_jni_tpu import exec as xc
+from spark_rapids_jni_tpu.models import tpcds
+from spark_rapids_jni_tpu.utils import metrics
+
+metrics.set_enabled(True)
+mode = os.environ["SRJT_SMOKE_MODE"]
+out_path = os.environ["SRJT_SMOKE_RESULT"]
+n_sales = int(os.environ["SRJT_SMOKE_N"])
+qnames = os.environ["SRJT_SMOKE_Q"].split(",")
+
+files = tpcds_data.generate(n_sales=n_sales, n_items=2_000, n_stores=10,
+                            seed=5)
+tables = tpcds.load_tables(files)
+
+def result_hash(result):
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(result):
+        a = np.ascontiguousarray(np.asarray(leaf))
+        h.update(a.dtype.str.encode())
+        h.update(repr(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+hashes = {}
+with xc.QueryScheduler(workers=2) as sched:
+    if sched._warmup_thread is not None:
+        sched._warmup_thread.join(timeout=60)
+    for q in qnames:
+        hashes[q] = result_hash(sched.run(q, tpcds.QUERIES[q], tables))
+        # second request: a live capture answers the first request with
+        # the capture run's own eager result — only this one compiles
+        # the replay program, persisting its XLA executable for the
+        # warm process to deserialize
+        sched.run(q, tpcds.QUERIES[q], tables)
+snap = metrics.snapshot()["counters"]
+doc = {"mode": mode, "hashes": hashes,
+       "capture": int(snap.get("compiled.capture", 0)),
+       "rehydrate": int(snap.get("compiled.rehydrate", 0)),
+       "aot_write": int(snap.get("aot.write", 0)),
+       "aot_hit": int(snap.get("aot.hit", 0)),
+       "aot_reject": int(snap.get("aot.reject", 0)),
+       "warmed": int(snap.get("exec.aot.warmed", 0)),
+       "ledger": {k: {m: round(float(x), 3) for m, x in v.items()}
+                  for k, v in metrics.ledger_snapshot().items()}}
+with open(out_path, "w") as f:
+    json.dump(doc, f, indent=1)
+print(f"[{mode}] capture={doc['capture']} rehydrate={doc['rehydrate']} "
+      f"aot_write={doc['aot_write']} aot_reject={doc['aot_reject']}")
+PYEOF
+
+run_once() {  # $1 = mode, $2 = result file
+    XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    SRJT_AOT_DIR="$AOT" \
+    SRJT_SMOKE_MODE="$1" SRJT_SMOKE_RESULT="$2" \
+    SRJT_SMOKE_N="$N_SALES" SRJT_SMOKE_Q="$QUERIES" \
+    python "$OUT/serve_once.py"
+}
+
+echo "== cold-start smoke: $QUERIES over $N_SALES rows =="
+echo "== process A: populate $AOT =="
+run_once populate "$OUT/populate.json"
+
+echo "== process B: warm serve (must be ZERO capture runs) =="
+run_once warm "$OUT/warm.json"
+
+echo "== process C: forced corruption (must degrade to capture) =="
+python - "$AOT" <<'PYEOF'
+import json, os, sys
+plans = os.path.join(sys.argv[1], "plans")
+victim = sorted(os.listdir(plans))[0]
+with open(os.path.join(plans, victim), "w") as f:
+    f.write('{"version": 1, "tape": [7, 13')     # torn write
+print(f"corrupted {victim}")
+PYEOF
+run_once corrupted "$OUT/corrupted.json"
+
+python - "$OUT" <<'PYEOF'
+import json, os, sys
+out = sys.argv[1]
+docs = {m: json.load(open(os.path.join(out, f"{m}.json")))
+        for m in ("populate", "warm", "corrupted")}
+a, b, c = docs["populate"], docs["warm"], docs["corrupted"]
+nq = len(a["hashes"])
+assert a["capture"] >= nq, a          # cold process captures every plan
+assert a["aot_write"] >= nq, a        # ...and persists every artifact
+assert b["capture"] == 0, \
+    f"warm process performed {b['capture']} capture runs — " \
+    "the zero-compile contract is broken"
+assert b["rehydrate"] >= nq and b["aot_hit"] >= nq, b
+assert b["hashes"] == a["hashes"], "rehydrated results diverged"
+led = b["ledger"]
+assert all(v.get("captures", 0) == 0 for v in led.values()), led
+assert c["aot_reject"] >= 1, c        # the corrupt artifact was rejected
+assert c["capture"] >= 1, c           # ...and degraded to live capture
+assert c["hashes"] == a["hashes"], "post-corruption results diverged"
+print(f"cold start OK: {nq} plans — populate capture={a['capture']}, "
+      f"warm capture=0 rehydrate={b['rehydrate']}, corruption degraded "
+      f"to {c['capture']} capture(s), all results bit-identical")
+PYEOF
+
+echo "coldstart smoke OK"

@@ -70,6 +70,8 @@ if [[ "${1:-}" != "--skip-tests" ]]; then
     ci/profile_smoke.sh
     echo "== ml smoke (ETL→ML handoff) =="
     ci/ml_smoke.sh
+    echo "== coldstart smoke (AOT plan-artifact store) =="
+    ci/coldstart_smoke.sh
 fi
 
 echo "premerge OK"

@@ -19,6 +19,10 @@ Parts, each its own module:
   request (``SRJT_EXEC_PLAN_CACHE_CAP``), with size-fingerprint plan
   sharing across refreshed same-shape data
   (``SRJT_EXEC_PLAN_SIZE_FP``) and vmapped batch execution.
+* :mod:`.artifacts` — persistent AOT plan-artifact store
+  (``SRJT_AOT_DIR``): capture tapes + warm-up manifest + the XLA
+  executable cache on disk, so a fresh process rehydrates previously-
+  seen plans with ZERO capture runs (zero-compile cold start).
 * :mod:`.placement` — per-device replica state (``SRJT_EXEC_DEVICES``):
   each device its own executor lifecycle, admission ledger, and
   identity-keyed placement cache; the scheduler routes whole requests to
@@ -42,7 +46,9 @@ import os
 
 from ..utils import knobs
 
+from . import artifacts
 from .admission import AdmissionController, AdmissionGrant, request_bytes
+from .artifacts import ArtifactStore, get_store
 from .errors import (ExecDeadlineExceeded, ExecError, ExecQueueFull,
                      ExecShutdown)
 from .placement import Replica, build_replicas, device_name
@@ -52,11 +58,11 @@ from .scheduler import QueryScheduler, QueryTicket
 from .slo import SloWatchdog, thresholds_from_env
 
 __all__ = [
-    "AdmissionController", "AdmissionGrant", "ExecDeadlineExceeded",
-    "ExecError", "ExecQueueFull", "ExecShutdown", "PlanCache",
-    "Prefetcher", "QueryScheduler", "QueryTicket", "Replica",
-    "SloWatchdog", "build_replicas", "device_name", "enabled",
-    "request_bytes", "thresholds_from_env",
+    "AdmissionController", "AdmissionGrant", "ArtifactStore",
+    "ExecDeadlineExceeded", "ExecError", "ExecQueueFull", "ExecShutdown",
+    "PlanCache", "Prefetcher", "QueryScheduler", "QueryTicket", "Replica",
+    "SloWatchdog", "artifacts", "build_replicas", "device_name",
+    "enabled", "get_store", "request_bytes", "thresholds_from_env",
 ]
 
 

@@ -101,8 +101,10 @@ requests submitted without one), ``SRJT_EXEC_DEVICES`` (default 1),
 ``SRJT_EXEC_RECOVERY`` (default 1), ``SRJT_EXEC_PROBE_BASE_S`` /
 ``SRJT_EXEC_PROBE_MAX_S`` (default 0.05 / 2.0),
 ``SRJT_EXEC_EJECT_AFTER`` (default 3), ``SRJT_EXEC_RELOCATE_MAX``
-(default: device count), plus the admission/prefetch/plan-cache knobs
-of the composed parts.
+(default: device count), ``SRJT_AOT_WARMUP`` (default 8; with
+``SRJT_AOT_DIR`` set, a background thread pre-hydrates that many
+top-cost artifacts from the AOT store at startup — ``exec/artifacts.py``),
+plus the admission/prefetch/plan-cache knobs of the composed parts.
 Histograms: ``exec.queue_wait_ms``, ``exec.admission_wait_ms``,
 ``exec.exec_ms``, ``exec.e2e_ms``, ``exec.batch.size``,
 ``exec.batch.coalesce_wait_ms``, and the ``exec.stage.*`` attribution
@@ -126,6 +128,7 @@ from ..faultinj.resilience import DeviceQuarantined
 from ..memory import budget as mbudget
 from ..models import compiled as C
 from ..utils import flight, knobs, metrics, structured_log
+from . import artifacts
 from .admission import request_bytes
 from .errors import (ExecDeadlineExceeded, ExecError, ExecQueueFull,
                      ExecShutdown)
@@ -287,6 +290,17 @@ class QueryScheduler:
                 target=self._recovery_loop, name="srjt-exec-probe",
                 daemon=True)
             self._probe_thread.start()
+        # AOT warm-up (exec/artifacts.py): pre-hydrate the costliest
+        # persisted plan artifacts on a low-priority background thread so
+        # the first requests' plan-cache lookups are memory hits.  Pure
+        # disk reads — never touches the device, never blocks serving.
+        self._warmup_thread: Optional[threading.Thread] = None
+        warm_n = knobs.get("SRJT_AOT_WARMUP")
+        if artifacts.enabled() and warm_n > 0:
+            self._warmup_thread = threading.Thread(
+                target=self._aot_warmup, args=(int(warm_n),),
+                name="srjt-exec-warmup", daemon=True)
+            self._warmup_thread.start()
 
     def pending(self) -> int:
         """Queued-but-undequeued request count (ops probe)."""
@@ -462,6 +476,8 @@ class QueryScheduler:
                 t.join(timeout=30)
             if self._probe_thread is not None:
                 self._probe_thread.join(timeout=5)
+            if self._warmup_thread is not None:
+                self._warmup_thread.join(timeout=5)
         for probe in ("scheduler.queue_depth", "scheduler.inflight_bytes",
                       "scheduler.plan_cache", "scheduler.slo",
                       "scheduler.replicas"):
@@ -506,6 +522,21 @@ class QueryScheduler:
                 self._serve(req, rep)
             else:
                 self._serve_batch(batch, rep)
+
+    def _aot_warmup(self, top_n: int) -> None:
+        """Background pre-hydration of the ``top_n`` costliest artifacts
+        in the store's warm-up manifest (``SRJT_AOT_WARMUP``).  Advisory:
+        any failure is swallowed — warm-up must never take serving down."""
+        try:
+            store = artifacts.get_store()
+            if store is None:
+                return
+            n = store.preload(top_n)
+            flight.record("exec.aot.warmup", loaded=n, top_n=top_n)
+            if n and metrics.recording():
+                metrics.count("exec.aot.warmed", n)
+        except Exception:
+            pass
 
     # -- fault lifecycle: relocation + recovery probe ------------------------
 

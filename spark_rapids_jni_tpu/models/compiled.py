@@ -79,22 +79,36 @@ class CompiledQuery:
     is the recorded size vector (its length is the eager sync count).
     """
 
-    def __init__(self, qfn: Callable, tables: Any):
+    def __init__(self, qfn: Callable, tables: Any, *,
+                 tape: Optional[tuple] = None):
         qname = self.name = getattr(qfn, "__name__", "query")
         # the compile-cost ledger keys on the plan fingerprint when the
         # qfn carries one (plan/lower.compile_plan does), else the name —
         # the ROADMAP cold-start item's attribution unit
         self._ledger_key = getattr(qfn, "plan_fingerprint", None) or qname
-        tape: list[int] = []
-        metrics.count("compiled.capture")
-        t0 = time.perf_counter()
-        with metrics.span(f"compiled.capture:{qname}"):
-            with syncs.capture(tape):
-                # eager capture run (and oracle)
-                self.expected = _materialized(qfn(tables))
-        metrics.ledger_add(self._ledger_key, captures=1,
-                           capture_ms=(time.perf_counter() - t0) * 1e3)
-        self.tape = tuple(tape)
+        if tape is None:
+            rec: list[int] = []
+            metrics.count("compiled.capture")
+            t0 = time.perf_counter()
+            with metrics.span(f"compiled.capture:{qname}"):
+                with syncs.capture(rec):
+                    # eager capture run (and oracle)
+                    self.expected = _materialized(qfn(tables))
+            metrics.ledger_add(self._ledger_key, captures=1,
+                               capture_ms=(time.perf_counter() - t0) * 1e3)
+            self.tape = tuple(rec)
+        else:
+            # rehydration (exec/artifacts.py): adopt a persisted capture
+            # tape WITHOUT the eager capture run.  There is no oracle
+            # result and the tape is unverified — the caller's first
+            # execution must be the CHECKED path, whose stacked-sync
+            # guard validates the tape against the live data (a mismatch
+            # raises StaleTapeError and falls back to live capture).
+            metrics.count("compiled.rehydrate")
+            self.expected = None
+            self.tape = tuple(int(v) for v in tape)
+            metrics.ledger_add(self._ledger_key, rehydrates=1)
+        self.rehydrated = tape is not None
         metrics.observe("compiled.tape_len", len(self.tape))
         self._trace_key = f"{qname}#{next(_plan_serial)}"
         self._dispatched = False
@@ -144,10 +158,26 @@ class CompiledQuery:
         one dispatch runs the plan.  Raises :class:`StaleTapeError` when
         the data's resolved sizes differ from the capture run's."""
         with metrics.span(f"compiled.run:{self.name}", tape_len=len(self.tape)):
-            if self.tape:
+            # a rehydrated plan checks even an EMPTY tape: the persisted
+            # tape being empty while the live plan resolves sizes is
+            # itself a divergence the sizes program must surface
+            if self.tape or self.rehydrated:
                 with metrics.span("compiled.tape_check"):
                     syncs.note_sync()    # the guard's one stacked D2H pull
-                    actual = np.asarray(self._sizes_prog(tables))
+                    try:
+                        actual = np.asarray(self._sizes_prog(tables))
+                    except RuntimeError as e:
+                        # replay divergence (tape too short/long for the
+                        # plan's resolution sites) — for a persisted tape
+                        # this is the stale-artifact case: degrade to a
+                        # live capture, never fail the request
+                        metrics.count("compiled.tape_mismatch")
+                        flight.incident("stale_tape", query=self.name,
+                                        tape_len=len(self.tape),
+                                        rehydrated=self.rehydrated,
+                                        error=str(e)[:200])
+                        raise StaleTapeError(
+                            f"compiled plan is stale: {e}") from e
                 if tuple(int(v) for v in actual) != self.tape:
                     diffs = [i for i, (a, b) in
                              enumerate(zip(actual, self.tape)) if int(a) != b]
@@ -282,6 +312,15 @@ class CompiledQuery:
 def compile_query(qfn: Callable, tables) -> CompiledQuery:
     """Capture ``qfn(tables)`` and return its single-program form."""
     return CompiledQuery(qfn, tables)
+
+
+def rehydrate_query(qfn: Callable, tape) -> CompiledQuery:
+    """A :class:`CompiledQuery` over a PERSISTED capture tape — no eager
+    capture run (the zero-compile cold-start path, ``exec/artifacts.py``).
+    The plan is unverified until its first checked :meth:`CompiledQuery.run`
+    validates the tape against live data; callers must route a
+    :class:`StaleTapeError` there into a live re-capture."""
+    return CompiledQuery(qfn, None, tape=tuple(tape))
 
 
 def plan_key(tables, *, by_size: bool = False) -> tuple[tuple, list]:

@@ -37,6 +37,30 @@ from . import ir
 _MAX_ENTRIES = 4096
 
 
+def atomic_write_json(path: str, doc) -> bool:
+    """Atomically write ``doc`` as JSON to ``path`` (tmp in the target
+    directory + ``os.replace``, never a torn file).  Returns False on any
+    OS failure — shared by the stats sidecar and the AOT artifact store
+    (``exec/artifacts.py``), both of which treat persistence as
+    best-effort."""
+    try:
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        fd, tmp = tempfile.mkstemp(prefix=".sidecar.", dir=d)
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(doc, f, separators=(",", ":"))
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except OSError:
+        return False
+    return True
+
+
 def _default_cap() -> int:
     try:
         return max(knobs.get("SRJT_PLAN_STATS_CAP"), 1)
@@ -147,23 +171,7 @@ class CardinalityStats:
         is best-effort, stats are advisory."""
         with self._lock:
             snap = dict(self._rows)
-        doc = {"version": 1, "rows": snap}
-        try:
-            d = os.path.dirname(os.path.abspath(path)) or "."
-            fd, tmp = tempfile.mkstemp(prefix=".plan_stats.", dir=d)
-            try:
-                with os.fdopen(fd, "w", encoding="utf-8") as f:
-                    json.dump(doc, f, separators=(",", ":"))
-                os.replace(tmp, path)
-            except BaseException:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-                raise
-        except OSError:
-            return False
-        return True
+        return atomic_write_json(path, {"version": 1, "rows": snap})
 
 
 #: process-wide store the executor feeds; pass to ``rules.optimize`` to

@@ -198,6 +198,23 @@ register("SRJT_EXEC_RELOCATE_MAX", None, _opt_int,
          "max failover hops per request before it errors (default: the "
          "device count)", "exec")
 
+# AOT plan-artifact store (exec/artifacts.py)
+register("SRJT_AOT_DIR", None, _opt_str,
+         "root of the persistent plan-artifact store (capture tapes + "
+         "warm-up manifest + the XLA executable cache under `<dir>/xla`); "
+         "unset disables AOT persistence", "aot")
+register("SRJT_AOT_GEOM_BUCKETS", "1", _on_unless_off,
+         "pow2-bucket input geometry in artifact keys so nearby dataset "
+         "sizes share one artifact; `0` keys on exact shapes", "aot")
+register("SRJT_AOT_WARMUP", "8", _int,
+         "manifest entries (ranked by compile-ledger cost) the scheduler "
+         "pre-hydrates in the background at startup; `0` disables the "
+         "warm-up thread", "aot")
+register("SRJT_AOT_XLA_CACHE", "1", _on_unless_off,
+         "point JAX's persistent compilation cache at `<SRJT_AOT_DIR>/"
+         "xla` (skipped when a cache dir is already configured); `0` "
+         "leaves the JAX config untouched", "aot")
+
 # SLO watchdog (exec/slo.py)
 register("SRJT_SLO_P50_MS", None, _opt_float,
          "rolling-window p50 latency objective per query class", "slo")
@@ -423,6 +440,7 @@ register("SRJT_BENCH_BUDGET_S", "1200", _float,
 
 _SECTION_TITLES = {
     "exec": "Serving runtime (`exec/`)",
+    "aot": "AOT artifact store (`exec/artifacts.py`)",
     "slo": "SLO watchdog (`exec/slo.py`)",
     "memory": "Memory arena (`memory/`)",
     "observability": "Observability (`utils/`)",

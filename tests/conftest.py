@@ -26,7 +26,17 @@ jax.config.update("jax_platforms", "cpu")
 # memory, so heavyweight programs (capture/replay traces, fused scans,
 # the mortgage ETL) recompile once per module — with the disk cache those
 # recompiles deserialize instead, keyed on HLO, across modules AND runs.
-jax.config.update("jax_compilation_cache_dir", ".jax_cache")
+# Absolute path (was a cwd-relative ".jax_cache", which silently forked a
+# fresh cold cache whenever pytest ran from another directory), and shared
+# with the AOT artifact-store layout: with SRJT_AOT_DIR set the executables
+# land in its `xla/` subdir — the same place exec/artifacts.py points
+# serving processes — so test and serving caches compose instead of
+# double-compiling.
+_aot_dir = os.environ.get("SRJT_AOT_DIR")
+_jax_cache = os.path.join(_aot_dir, "xla") if _aot_dir else os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _jax_cache)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 

@@ -97,13 +97,19 @@ def _ingest_lines(counters: dict, events: list) -> list[str]:
 
 def _ledger_lines(ledger: dict) -> list[str]:
     """The compile-cost ledger table: per plan fingerprint, where the
-    compile budget went (capture/trace ms, recompiles, cache hits)."""
+    compile budget went (capture/trace ms, recompiles, cache hits), with
+    cold/warm attribution — cold = the one-time capture + trace +
+    first-dispatch cost this process paid, rehydrates = plans adopted
+    from the AOT artifact store (``exec/artifacts.py``) whose capture
+    cost was paid by an EARLIER process instead."""
     if not ledger:
         return ["  (no compiled plans this process)"]
     out = []
     for plan in sorted(ledger):
         e = ledger[plan]
         traces = e.get("traces", 0)
+        cold_ms = (e.get("capture_ms", 0) + e.get("trace_ms", 0)
+                   + e.get("first_dispatch_ms", 0))
         out.append(
             f"  {plan}")
         out.append(
@@ -113,7 +119,11 @@ def _ledger_lines(ledger: dict) -> list[str]:
             f"{max(traces - 1, 0):.0f} recompile)  "
             f"first-dispatch {e.get('first_dispatch_ms', 0):.1f} ms")
         out.append(
-            f"    runs {e.get('runs', 0):.0f}  cache hit/size/miss "
+            f"    cold {cold_ms:.1f} ms  "
+            f"rehydrates {e.get('rehydrates', 0):.0f} (AOT, zero-capture)  "
+            f"warm runs {e.get('runs', 0):.0f}")
+        out.append(
+            f"    cache hit/size/miss "
             f"{e.get('cache_hits', 0):.0f}/"
             f"{e.get('cache_size_hits', 0):.0f}/"
             f"{e.get('cache_misses', 0):.0f}")
