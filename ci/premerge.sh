@@ -72,6 +72,8 @@ if [[ "${1:-}" != "--skip-tests" ]]; then
     ci/ml_smoke.sh
     echo "== coldstart smoke (AOT plan-artifact store) =="
     ci/coldstart_smoke.sh
+    echo "== sql smoke (SQL front-end / submit_sql) =="
+    ci/sql_smoke.sh
 fi
 
 echo "premerge OK"

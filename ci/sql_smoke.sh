@@ -1,0 +1,124 @@
+#!/usr/bin/env bash
+# SQL front-end smoke (sql/ + exec.submit_sql) — the serve-arbitrary-SQL
+# runbook, asserted end to end: a mixed TPC-DS slice (joins, rollup,
+# semi/anti, UNION ALL, windows) is served twice — once from hand-built
+# plan trees, once from SQL text through QueryScheduler.submit_sql — and
+# the results must be bit-identical; the SQL submission must land a
+# plan-cache HIT on the entry the hand tree compiled (shared structural
+# fingerprint, zero extra compiles); a malformed query must raise a
+# caret-positioned SqlError AND count a sql_parse_error flight incident;
+# and tools/sql_bench.py must report every corpus fingerprint shared.
+# Artifacts land in target/sql_smoke/.
+#
+# Usage: ci/sql_smoke.sh [n_sales] [queries]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N_SALES="${1:-20000}"
+QUERIES="${2:-q3,q55,q36_rollup,q16_anti,q_union_channels,q67_rank}"
+OUT=target/sql_smoke
+rm -rf "$OUT"
+mkdir -p "$OUT"
+
+echo "== sql smoke: $QUERIES over $N_SALES rows =="
+SRJT_SMOKE_N="$N_SALES" SRJT_SMOKE_Q="$QUERIES" SRJT_SMOKE_OUT="$OUT" \
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+python - <<'PYEOF'
+"""Serve the mix twice — hand trees vs submit_sql — and assert the SQL
+path is a bit-identical, compile-free alias of the hand path."""
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+import jax
+
+from benchmarks import tpcds_data
+from spark_rapids_jni_tpu import exec as xc
+from spark_rapids_jni_tpu import sql as sql_fe
+from spark_rapids_jni_tpu.models import tpcds, tpcds_sql as TS
+from spark_rapids_jni_tpu.plan import ir, lower, rules
+from spark_rapids_jni_tpu.sql import SqlError
+from spark_rapids_jni_tpu.utils import flight, metrics
+
+metrics.set_enabled(True)
+flight.set_enabled(True)
+qnames = os.environ["SRJT_SMOKE_Q"].split(",")
+out_dir = os.environ["SRJT_SMOKE_OUT"]
+
+files = tpcds_data.generate(n_sales=int(os.environ["SRJT_SMOKE_N"]),
+                            n_items=300, seed=11)
+tables = tpcds.load_tables(files)
+SCHEMAS = TS.TABLE_SCHEMAS
+
+
+def result_hash(result):
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(result):
+        a = np.ascontiguousarray(np.asarray(leaf))
+        h.update(a.dtype.str.encode())
+        h.update(repr(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+doc = {"queries": {}}
+with xc.QueryScheduler(workers=2) as sched:
+    for q in qnames:
+        params = TS.PARAMS.get(q, {})
+        hand = rules.optimize(TS.hand_tree(q), SCHEMAS).tree
+        h_hand = result_hash(
+            sched.run(ir.fingerprint(hand), lower.compile_plan(hand,
+                                                               SCHEMAS),
+                      tables))
+        hit0 = metrics.counter_value("exec.plan_cache.hit")
+        h_sql = result_hash(
+            sched.submit_sql(TS.SQL[q], tables, schemas=SCHEMAS,
+                             params=params).result())
+        hit1 = metrics.counter_value("exec.plan_cache.hit")
+        assert h_sql == h_hand, f"{q}: SQL result diverged from hand tree"
+        assert hit1 == hit0 + 1, \
+            f"{q}: SQL submission missed the hand tree's plan-cache " \
+            f"entry (hit {hit0} -> {hit1}) — fingerprints diverged"
+        doc["queries"][q] = {"hash": h_sql, "cache_hit": True}
+        print(f"[sql] {q}: bit-identical, plan-cache HIT")
+
+    # a malformed query: caret-positioned error + flight incident
+    inc0 = metrics.counter_value("flight.incident.sql_parse_error")
+    try:
+        sched.submit_sql("SELECT FROM store_sales", tables,
+                         schemas=SCHEMAS)
+    except SqlError as e:
+        assert e.line == 1 and e.col == 8, (e.line, e.col)
+        assert "^" in str(e), "caret missing from rendered error"
+    else:
+        raise AssertionError("malformed SQL did not raise SqlError")
+    assert metrics.counter_value(
+        "flight.incident.sql_parse_error") == inc0 + 1, \
+        "sql_parse_error incident not counted"
+    print("[sql] malformed query: caret at 1:8, incident counted")
+
+doc["sql_cache"] = sql_fe.cache_stats()
+with open(os.path.join(out_dir, "smoke.json"), "w") as f:
+    json.dump(doc, f, indent=1)
+PYEOF
+
+echo "== sql bench (parse/bind/optimize overhead, shared fingerprints) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+python tools/sql_bench.py 3 "$OUT/SQL_BENCH.json" > "$OUT/bench.log"
+python - "$OUT/SQL_BENCH.json" <<'PYEOF'
+import json, sys
+s = json.load(open(sys.argv[1]))["summary"]
+assert s["all_fingerprints_shared"], \
+    "a corpus query's SQL fingerprint diverged from its hand tree"
+assert s["median_warm_us"] < s["median_cold_overhead_us"], s
+print(f"bench OK: {s['n_queries']} queries, fingerprints shared, "
+      f"cold overhead {s['median_cold_overhead_us']}us vs warm "
+      f"{s['median_warm_us']}us")
+PYEOF
+
+echo "sql smoke OK"

@@ -120,6 +120,7 @@ import os
 import random
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Callable, Optional
 
 from ..analysis import sanitize
@@ -257,6 +258,11 @@ class QueryScheduler:
         self.admission = self.replicas[0].admission
         self.resilient = self.replicas[0].resilient
         self.plans = plan_cache if plan_cache is not None else PlanCache()
+        # SQL qfn memo: plan fingerprint + schema → one stable callable,
+        # so repeat submit_sql calls coalesce (ckey uses id(qfn)) and hit
+        # the same plan-cache entry as an equivalent hand-built tree
+        self._sql_qfns: "OrderedDict[tuple, Callable]" = OrderedDict()
+        self._sql_lock = threading.Lock()
         self.prefetcher = Prefetcher() if prefetch else None
         self.slo = SloWatchdog()
         self._heap: list[tuple[int, int, _Request]] = []
@@ -448,6 +454,49 @@ class QueryScheduler:
         return self.submit(f"predict:{sv.name}", sv.qfn, tables,
                            loader=loader, priority=priority,
                            timeout_s=timeout_s, nbytes=nbytes)
+
+    def submit_sql(self, text: str, tables=None, *, schemas,
+                   params: Optional[dict] = None,
+                   loader: Optional[Callable[[], Any]] = None,
+                   priority: int = 0,
+                   timeout_s: Optional[float] = None,
+                   nbytes: Optional[int] = None) -> QueryTicket:
+        """Serve a SQL query (``sql/``) through the ordinary pipeline.
+
+        The text is parsed, bound against ``schemas`` (table → column
+        names), rule-optimized, and lowered to the same ``qfn`` shape a
+        hand-built plan tree compiles to — then submitted under the
+        plan's STRUCTURAL FINGERPRINT as the request name, so a SQL-born
+        query and an equivalently-shaped hand-built tree share one
+        plan-cache/AOT entry and coalesce into one launch.  Warm repeats
+        are amortized-free: the SQL memo (``SRJT_SQL_CACHE``) skips
+        parse+bind+optimize, the per-scheduler qfn memo returns the same
+        callable, and the plan cache returns the compiled program.
+        Malformed SQL raises :class:`~..sql.SqlError` (with a source
+        caret) at submit time and records a ``sql_parse_error``
+        incident — nothing is enqueued."""
+        from .. import sql as sql_fe
+        from ..plan import ir as plan_ir
+        tree = sql_fe.sql_to_plan(text, schemas, params)  # SqlError here
+        fp = plan_ir.fingerprint(tree)
+        key = (fp, tuple(sorted((t, tuple(c)) for t, c in schemas.items())))
+        with self._sql_lock:
+            qfn = self._sql_qfns.get(key)
+            if qfn is not None:
+                self._sql_qfns.move_to_end(key)
+        if qfn is None:
+            from ..plan import lower as plan_lower
+            qfn = plan_lower.compile_plan(tree, schemas)
+            with self._sql_lock:
+                qfn = self._sql_qfns.setdefault(key, qfn)
+                while len(self._sql_qfns) > 256:
+                    self._sql_qfns.popitem(last=False)
+        if metrics.recording():
+            metrics.count("sql.submitted")
+        flight.record("sql.submit", fingerprint=fp, chars=len(text))
+        return self.submit(fp, qfn, tables, loader=loader,
+                           priority=priority, timeout_s=timeout_s,
+                           nbytes=nbytes)
 
     # -- lifecycle -----------------------------------------------------------
 

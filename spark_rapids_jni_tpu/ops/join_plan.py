@@ -587,7 +587,12 @@ def plan_keys(left_cols: Sequence[Column],
             lc, rc = strings.encode_shared([lc, rc])
         enc_l.append(lc)
         enc_r.append(rc)
-    if k == 1:
+    if k == 1 and not any(force_column(c).dtype.id == T.TypeId.DECIMAL128
+                          for c in (enc_l[0], enc_r[0])):
+        # decimal128 is excluded: its (n, 2) limb storage has no single
+        # probe lane, so it packs below like a 2-lane tuple — hashed
+        # fingerprint probe + exact limb verification — instead of
+        # handing the sort-probe engine a 2-D array
         from .join import _key_with_nulls_last
         lc, rc = enc_l[0], enc_r[0]
         ldata, lvalid = _key_with_nulls_last(force_column(lc))

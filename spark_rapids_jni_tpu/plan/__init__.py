@@ -11,10 +11,10 @@ capture/replay and the serving runtime.
 from . import adaptive, ir, lower, profile, rules, stats
 from .adaptive import (AdaptiveReport, compile_adaptive_plan,
                        execute_adaptive, explain_adaptive)
-from .ir import (Aggregate, And, Between, Cmp, Col, Filter,
-                 FusedJoinAggregate, IsIn, Join, Limit, Lit, Mul, Or, Plan,
-                 PlanError, Project, ScalarAgg, Scan, Sort, Window,
-                 expr_columns, fingerprint, render, schema_of)
+from .ir import (GROUPING_ID, Aggregate, And, Between, Cmp, Col, Distinct,
+                 Filter, FusedJoinAggregate, IsIn, Join, Limit, Lit, Mul, Or,
+                 Plan, PlanError, Project, ScalarAgg, Scan, Sort, Union,
+                 Window, expr_columns, fingerprint, render, schema_of)
 from .lower import (FileCatalog, TableCatalog, compile_plan, execute,
                     rowgroup_conditions)
 from .profile import NodeProfile, QueryProfile, explain_analyze
@@ -28,7 +28,8 @@ __all__ = [
     "AdaptiveReport", "compile_adaptive_plan", "execute_adaptive",
     "explain_adaptive",
     "Plan", "PlanError", "Scan", "Filter", "Project", "Join", "Aggregate",
-    "FusedJoinAggregate", "Window", "Sort", "Limit",
+    "FusedJoinAggregate", "Window", "Sort", "Limit", "Union", "Distinct",
+    "GROUPING_ID",
     "Col", "Lit", "Cmp", "Between", "And", "Or", "IsIn", "ScalarAgg", "Mul",
     "schema_of", "fingerprint", "render", "expr_columns",
     "optimize", "explain", "DEFAULT_RULES", "OptimizeResult",

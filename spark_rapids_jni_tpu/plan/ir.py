@@ -218,18 +218,41 @@ class Join(Plan):
         object.__setattr__(self, "right_on", tuple(self.right_on))
 
 
+#: name of the synthetic column grouping aggregates append (bit k set when
+#: key k was aggregated away; MSB = first key — matches ``ops.groupby``).
+GROUPING_ID = "grouping_id"
+
+
 @dataclass(frozen=True)
 class Aggregate(Plan):
     """GROUP BY ``keys``; ``aggs`` are ``(value_column, fn, out_name)``
-    with fn from the ops groupby set (sum/mean/count/min/max/...)."""
+    with fn from the ops groupby set (sum/mean/count/min/max/... plus
+    ``nunique`` = COUNT(DISTINCT), single-agg only).
+
+    ``grouping`` widens plain GROUP BY to multi-level grouping:
+    ``"rollup"`` / ``"cube"`` derive their grouping sets from ``keys``;
+    ``"sets"`` takes explicit ``grouping_sets`` — tuples of positions
+    into ``keys`` (the ``ops.groupby_grouping_sets`` convention).  Any
+    grouping spec appends a ``grouping_id`` int64 column to the output
+    schema."""
     child: Plan
     keys: Tuple[str, ...]
     aggs: Tuple[Tuple[str, str, str], ...]
+    grouping: Optional[str] = None              # None|"rollup"|"cube"|"sets"
+    grouping_sets: Optional[Tuple[Tuple[int, ...], ...]] = None
 
     def __post_init__(self):
         object.__setattr__(self, "keys", tuple(self.keys))
         object.__setattr__(self, "aggs",
                            tuple(tuple(a) for a in self.aggs))
+        if self.grouping_sets is not None:
+            object.__setattr__(self, "grouping_sets",
+                               tuple(tuple(s) for s in self.grouping_sets))
+        if self.grouping not in (None, "rollup", "cube", "sets"):
+            raise PlanError(f"unknown grouping spec {self.grouping!r}")
+        if (self.grouping == "sets") != (self.grouping_sets is not None):
+            raise PlanError("grouping_sets requires grouping='sets' "
+                            "(and vice versa)")
 
 
 @dataclass(frozen=True)
@@ -258,16 +281,46 @@ class FusedJoinAggregate(Plan):
 @dataclass(frozen=True)
 class Window(Plan):
     """Append one window-function column named ``out``
-    (``fn`` in row_number/rank/dense_rank over ``ops.window``)."""
+    (``fn`` in row_number/rank/dense_rank/running_sum/lag over
+    ``ops.window``).  ``ascending`` optionally orders each order key
+    descending (parallel to ``order_by``); ``value`` names the input
+    column for value-carrying fns (running_sum/lag).  Both default to
+    None and stay out of the fingerprint when unset, so pre-existing
+    rank/row_number trees keep their historical cache keys."""
     child: Plan
     fn: str
     partition_by: Tuple[str, ...]
     order_by: Tuple[str, ...]
     out: str
+    ascending: Optional[Tuple[bool, ...]] = None
+    value: Optional[str] = None
 
     def __post_init__(self):
         object.__setattr__(self, "partition_by", tuple(self.partition_by))
         object.__setattr__(self, "order_by", tuple(self.order_by))
+        object.__setattr__(self, "ascending", _tup(self.ascending))
+
+
+@dataclass(frozen=True)
+class Union(Plan):
+    """UNION ALL: positional concatenation of ``parts`` (each the same
+    arity and per-position dtype); output columns are renamed to
+    ``names`` (the first arm's aliases, SQL-style)."""
+    parts: Tuple[Plan, ...]
+    names: Tuple[str, ...]
+
+    def __post_init__(self):
+        object.__setattr__(self, "parts", tuple(self.parts))
+        object.__setattr__(self, "names", tuple(self.names))
+        if len(self.parts) < 2:
+            raise PlanError("union needs at least two parts")
+
+
+@dataclass(frozen=True)
+class Distinct(Plan):
+    """Row-level DISTINCT over the child's full schema (lowers to the
+    grouped-by-all-columns path; output order is the key sort order)."""
+    child: Plan
 
 
 @dataclass(frozen=True)
@@ -293,7 +346,10 @@ class Limit(Plan):
 def children(node: Plan) -> tuple[Plan, ...]:
     if isinstance(node, (Join, FusedJoinAggregate)):
         return (node.left, node.right)
-    if isinstance(node, (Filter, Project, Aggregate, Window, Sort, Limit)):
+    if isinstance(node, Union):
+        return node.parts
+    if isinstance(node, (Filter, Project, Aggregate, Window, Sort, Limit,
+                         Distinct)):
         return (node.child,)
     return ()
 
@@ -301,7 +357,10 @@ def children(node: Plan) -> tuple[Plan, ...]:
 def with_children(node: Plan, kids: tuple[Plan, ...]) -> Plan:
     if isinstance(node, (Join, FusedJoinAggregate)):
         return replace(node, left=kids[0], right=kids[1])
-    if isinstance(node, (Filter, Project, Aggregate, Window, Sort, Limit)):
+    if isinstance(node, Union):
+        return replace(node, parts=tuple(kids))
+    if isinstance(node, (Filter, Project, Aggregate, Window, Sort, Limit,
+                         Distinct)):
         return replace(node, child=kids[0])
     return node
 
@@ -354,6 +413,8 @@ def schema_of(node: Plan, schemas: dict) -> tuple[str, ...]:
         rs = schema_of(node.right, schemas)
         _need(node.left_on, ls, "join left keys")
         _need(node.right_on, rs, "join right keys")
+        if node.how in ("semi", "anti"):
+            return ls                       # right side filters, never lands
         dup = set(ls) & set(rs)
         if dup:
             raise PlanError(f"join sides share column names {sorted(dup)}")
@@ -362,7 +423,8 @@ def schema_of(node: Plan, schemas: dict) -> tuple[str, ...]:
         sch = schema_of(node.child, schemas)
         _need(node.keys, sch, "aggregate keys")
         _need([a[0] for a in node.aggs], sch, "aggregate values")
-        return node.keys + tuple(a[2] for a in node.aggs)
+        out = node.keys + tuple(a[2] for a in node.aggs)
+        return out + (GROUPING_ID,) if node.grouping else out
     if isinstance(node, FusedJoinAggregate):
         ls = schema_of(node.left, schemas)
         rs = schema_of(node.right, schemas)
@@ -373,7 +435,20 @@ def schema_of(node: Plan, schemas: dict) -> tuple[str, ...]:
     if isinstance(node, Window):
         sch = schema_of(node.child, schemas)
         _need(node.partition_by + node.order_by, sch, "window keys")
+        if node.value is not None:
+            _need((node.value,), sch, "window value")
         return sch + (node.out,)
+    if isinstance(node, Union):
+        arity = len(node.names)
+        for i, p in enumerate(node.parts):
+            psch = schema_of(p, schemas)
+            if len(psch) != arity:
+                raise PlanError(
+                    f"union arm {i} has {len(psch)} columns, expected "
+                    f"{arity} ({list(node.names)})")
+        return node.names
+    if isinstance(node, Distinct):
+        return schema_of(node.child, schemas)
     if isinstance(node, (Sort, Limit)):
         sch = schema_of(node.child, schemas)
         if isinstance(node, Sort):
@@ -454,8 +529,18 @@ def _sexp(node: Plan) -> str:
                 f"[{keys}]{eng})")
     if isinstance(node, Aggregate):
         aggs = ",".join(f"{fn}({c})>{o}" for c, fn, o in node.aggs)
+        # grouping spec participates only when SET: plain GROUP BY trees
+        # keep their historical fingerprints
+        if node.grouping is None:
+            grp = ""
+        elif node.grouping == "sets":
+            sets = ";".join(",".join(map(str, s))
+                            for s in node.grouping_sets)
+            grp = f",g=sets[{sets}]"
+        else:
+            grp = f",g={node.grouping}"
         return (f"agg({_sexp(node.child)},[{','.join(node.keys)}],"
-                f"[{aggs}])")
+                f"[{aggs}]{grp})")
     if isinstance(node, FusedJoinAggregate):
         keys = ",".join(f"{l}={r}"
                         for l, r in zip(node.left_on, node.right_on))
@@ -465,9 +550,20 @@ def _sexp(node: Plan) -> str:
                 f"{_sexp(node.right)},[{keys}],[{','.join(node.keys)}],"
                 f"[{aggs}]{eng})")
     if isinstance(node, Window):
+        # ascending/value participate only when SET (fingerprint
+        # back-compat, same discipline as Join.engine)
+        asc = ("" if node.ascending is None
+               else ",a=" + "".join("1" if a else "0"
+                                    for a in node.ascending))
+        val = "" if node.value is None else f",v={node.value}"
         return (f"window({_sexp(node.child)},{node.fn},"
                 f"[{','.join(node.partition_by)}],"
-                f"[{','.join(node.order_by)}],{node.out})")
+                f"[{','.join(node.order_by)}],{node.out}{asc}{val})")
+    if isinstance(node, Union):
+        parts = ",".join(_sexp(p) for p in node.parts)
+        return f"union([{parts}],[{','.join(node.names)}])"
+    if isinstance(node, Distinct):
+        return f"distinct({_sexp(node.child)})"
     if isinstance(node, Sort):
         asc = ("-" if node.ascending is None
                else "".join("1" if a else "0" for a in node.ascending))
@@ -533,7 +629,9 @@ def _node_line(node: Plan) -> str:
         return f"Join {node.how} on ({keys}){eng}"
     if isinstance(node, Aggregate):
         aggs = ", ".join(f"{fn}({c}) AS {o}" for c, fn, o in node.aggs)
-        return f"Aggregate keys=[{', '.join(node.keys)}] aggs=[{aggs}]"
+        grp = "" if node.grouping is None else f" grouping={node.grouping}"
+        return (f"Aggregate keys=[{', '.join(node.keys)}] "
+                f"aggs=[{aggs}]{grp}")
     if isinstance(node, FusedJoinAggregate):
         keys = ", ".join(f"{l} = {r}"
                          for l, r in zip(node.left_on, node.right_on))
@@ -542,8 +640,14 @@ def _node_line(node: Plan) -> str:
         return (f"FusedJoinAggregate {node.how} on ({keys}) "
                 f"keys=[{', '.join(node.keys)}] aggs=[{aggs}]{eng}")
     if isinstance(node, Window):
-        return (f"Window {node.fn} partition=[{', '.join(node.partition_by)}]"
+        val = "" if node.value is None else f" value={node.value}"
+        return (f"Window {node.fn}{val}"
+                f" partition=[{', '.join(node.partition_by)}]"
                 f" order=[{', '.join(node.order_by)}] AS {node.out}")
+    if isinstance(node, Union):
+        return f"Union [{', '.join(node.names)}]"
+    if isinstance(node, Distinct):
+        return "Distinct"
     if isinstance(node, Sort):
         return f"Sort keys=[{', '.join(node.keys)}]"
     if isinstance(node, Limit):

@@ -33,9 +33,11 @@ import numpy as np
 
 from .. import types as T
 from ..column import Column, Table
-from ..ops import (apply_boolean_mask, groupby_aggregate, inner_join,
-                   join_aggregate, left_join, mean, slice_table, sort_table,
-                   sum_)
+from ..ops import (anti_join, apply_boolean_mask, concat_tables, distinct,
+                   groupby_aggregate, groupby_cube, groupby_grouping_sets,
+                   groupby_nunique, groupby_rollup, inner_join,
+                   join_aggregate, left_join, mean, semi_join, slice_table,
+                   sort_table, sum_)
 from ..ops import strings as S
 from ..ops import window as W
 from ..utils import metrics
@@ -347,13 +349,14 @@ def _apply_node(node: ir.Plan, kids: list, catalog, record_stats: bool):
         names = list(node.columns)
     elif isinstance(node, ir.Join):
         (lt, ln), (rt, rn) = kids
-        fn = {"inner": inner_join, "left": left_join}.get(node.how)
+        fn = {"inner": inner_join, "left": left_join,
+              "semi": semi_join, "anti": anti_join}.get(node.how)
         if fn is None:
             raise ir.PlanError(f"unsupported join type {node.how!r}")
         with _engine_pin(node):
             t = fn(lt, rt, _on_arg(_key_indices(ln, node.left_on)),
                    _on_arg(_key_indices(rn, node.right_on)))
-        names = ln + rn
+        names = ln if node.how in ("semi", "anti") else ln + rn
     elif isinstance(node, ir.FusedJoinAggregate):
         (lt, ln), (rt, rn) = kids
         joined = ln + rn
@@ -367,14 +370,32 @@ def _apply_node(node: ir.Plan, kids: list, catalog, record_stats: bool):
         names = list(node.keys) + [a[2] for a in node.aggs]
     elif isinstance(node, ir.Aggregate):
         ct, cnames = kids[0]
-        t = groupby_aggregate(
-            ct, _key_indices(cnames, node.keys),
-            [(cnames.index(c), fn) for c, fn, _out in node.aggs])
+        key_idx = _key_indices(cnames, node.keys)
+        agg_arg = [(cnames.index(c), fn) for c, fn, _out in node.aggs]
         names = list(node.keys) + [a[2] for a in node.aggs]
+        if node.grouping is not None:
+            gfn = {"rollup": groupby_rollup, "cube": groupby_cube}.get(
+                node.grouping)
+            if gfn is not None:
+                t = gfn(ct, key_idx, agg_arg)
+            else:
+                t = groupby_grouping_sets(ct, key_idx,
+                                          node.grouping_sets, agg_arg)
+            names = names + [ir.GROUPING_ID]
+        elif any(fn == "nunique" for _c, fn, _o in node.aggs):
+            if len(node.aggs) != 1:
+                raise ir.PlanError(
+                    "nunique aggregate must be the only aggregation")
+            t = groupby_nunique(ct, key_idx,
+                                cnames.index(node.aggs[0][0]))
+        else:
+            t = groupby_aggregate(ct, key_idx, agg_arg)
     elif isinstance(node, ir.Window):
         ct, cnames = kids[0]
+        asc = None if node.ascending is None else list(node.ascending)
         spec = W.WindowSpec(ct, _key_indices(cnames, node.partition_by),
-                            _key_indices(cnames, node.order_by))
+                            _key_indices(cnames, node.order_by),
+                            ascending=asc)
         order_idx = _key_indices(cnames, node.order_by)
         if node.fn == "row_number":
             wcol = W.row_number(spec)
@@ -382,10 +403,24 @@ def _apply_node(node: ir.Plan, kids: list, catalog, record_stats: bool):
             wcol = W.rank(spec, order_idx)
         elif node.fn == "dense_rank":
             wcol = W.dense_rank(spec, order_idx)
+        elif node.fn in ("running_sum", "lag", "lead"):
+            if node.value is None:
+                raise ir.PlanError(f"window {node.fn} needs a value column")
+            vidx = cnames.index(node.value)
+            wfn = {"running_sum": W.running_sum, "lag": W.lag,
+                   "lead": W.lead}[node.fn]
+            wcol = wfn(spec, vidx)
         else:
             raise ir.PlanError(f"unsupported window function {node.fn!r}")
         t = Table(list(ct.columns) + [wcol])
         names = cnames + [node.out]
+    elif isinstance(node, ir.Union):
+        t = concat_tables([k[0] for k in kids])
+        names = list(node.names)
+    elif isinstance(node, ir.Distinct):
+        ct, cnames = kids[0]
+        t = distinct(ct)
+        names = cnames
     elif isinstance(node, ir.Sort):
         ct, cnames = kids[0]
         asc = None if node.ascending is None else list(node.ascending)

@@ -18,6 +18,10 @@ Four rules, applied in a loop until a full pass changes nothing:
 * **fuse_join_aggregate** — detect ``Aggregate(Join(...))`` with an
   inner/left join and emit the fused ``ops/join_plan.join_aggregate``
   path (``FusedJoinAggregate`` node) instead of a per-query rewire.
+* **fuse_join_window** — push a ``Window`` below a left join whose
+  build side is provably unique on its keys (an ``Aggregate`` or
+  ``Distinct`` on exactly those columns), so the window runs on the
+  narrow pre-join table instead of the widened join output.
 
 Metrics (when recording): ``plan.rule.fired.<name>`` /
 ``plan.rule.rejected.<name>`` counters and a ``plan.optimize`` span that
@@ -132,11 +136,21 @@ class ProjectionPushdown(Rule):
                                  (self._push(node.left, lneed, ctx),
                                   self._push(node.right, rneed, ctx)))
         if isinstance(node, ir.Window):
+            val = set() if node.value is None else {node.value}
             cneed = (None if need is None
                      else frozenset((need - {node.out})
                                     | set(node.partition_by)
-                                    | set(node.order_by)))
+                                    | set(node.order_by) | val))
             return self._rebuild(node, (self._push(node.child, cneed, ctx),))
+        if isinstance(node, ir.Union):
+            # arms are positional: ancestors' name-based needs don't
+            # translate, but each arm's own Projects reset the
+            # requirement so scan narrowing still happens below
+            return self._rebuild(
+                node, tuple(self._push(p, None, ctx) for p in node.parts))
+        if isinstance(node, ir.Distinct):
+            # distinct is over the child's FULL row — everything is needed
+            return self._rebuild(node, (self._push(node.child, None, ctx),))
         if isinstance(node, ir.Sort):
             cneed = None if need is None else need | set(node.keys)
             return self._rebuild(node, (self._push(node.child, cneed, ctx),))
@@ -217,9 +231,51 @@ class FilterPushdown(Rule):
             out = replace(child, left=nl, right=nr)
             return ir.Filter(out, ir.and_(keep)) if keep else out
 
+        if isinstance(child, ir.Union):
+            # positional rename per arm, then push into every arm (same
+            # rows survive; concat of filtered arms == filtered concat)
+            new_parts = []
+            for part in child.parts:
+                psch = ctx.schema(part)
+                mapping = dict(zip(child.names, psch))
+                new_parts.append(ir.Filter(
+                    part, _rename_expr(node.predicate, mapping)))
+            ctx.fire(self.name,
+                     f"filter through union ({len(new_parts)} arms)")
+            return replace(child, parts=tuple(new_parts))
+
+        if isinstance(child, ir.Distinct):
+            # distinct(filter(x)) == filter(distinct(x)): same surviving
+            # key set, same key-sorted output order
+            ctx.fire(self.name, "filter below distinct")
+            return ir.Distinct(ir.Filter(child.child, node.predicate))
+
         # Sort/Limit/Aggregate/Window: order- or group-sensitive —
         # predicates stay put (HAVING-style filters land here)
         return None
+
+
+def _rename_expr(e, mapping: dict):
+    """Rewrite every Col reference through ``mapping`` (missing = keep)."""
+    if e is None:
+        return None
+    if isinstance(e, ir.Col):
+        return ir.Col(mapping.get(e.name, e.name))
+    if isinstance(e, ir.Cmp):
+        return ir.Cmp(e.op, _rename_expr(e.left, mapping),
+                      _rename_expr(e.right, mapping))
+    if isinstance(e, ir.Between):
+        return replace(e, col=_rename_expr(e.col, mapping))
+    if isinstance(e, (ir.And, ir.Or)):
+        return type(e)(tuple(_rename_expr(p, mapping) for p in e.parts))
+    if isinstance(e, ir.IsIn):
+        return replace(e, col=_rename_expr(e.col, mapping))
+    if isinstance(e, ir.ScalarAgg):
+        return ir.ScalarAgg(e.fn, _rename_expr(e.arg, mapping))
+    if isinstance(e, ir.Mul):
+        return ir.Mul(_rename_expr(e.left, mapping),
+                      _rename_expr(e.right, mapping))
+    return e                          # Lit and friends: no columns
 
 
 # --- join reorder -----------------------------------------------------------
@@ -286,6 +342,11 @@ class FuseJoinAggregate(Rule):
         c = node.child
         if not isinstance(c, ir.Join):
             return None
+        if node.grouping is not None or any(a[1] == "nunique"
+                                            for a in node.aggs):
+            ctx.reject(self.name,
+                       "grouping-spec/nunique aggregate is unfusable")
+            return None
         if c.how not in ("inner", "left"):
             ctx.reject(self.name, f"unfusable join type {c.how!r}")
             return None
@@ -296,9 +357,68 @@ class FuseJoinAggregate(Rule):
                                      c.how)
 
 
+# --- join→window fusion -----------------------------------------------------
+
+
+class FuseJoinWindow(Rule):
+    """Push a Window below a left join with a provably-unique build side.
+
+    ``Window(Join(left, right, how="left"))`` == ``Join(Window(left),
+    right)`` when (a) every window input column lives on ``left`` and
+    (b) ``right`` is unique on its join keys, so each left row lands in
+    the output exactly once.  Uniqueness is only claimed when it is
+    structural: the right child is an ``Aggregate`` grouped exactly on
+    the join keys, or a ``Distinct`` whose schema is exactly the join
+    keys.  The trailing Project restores the original column order, so
+    the rewrite is invisible above — and the window now runs on the
+    narrow pre-join table instead of the gather-widened join output."""
+
+    name = "fuse_join_window"
+
+    def apply(self, tree, ctx):
+        return ir.transform_up(tree, lambda n: self._rewrite(n, ctx))
+
+    def _rewrite(self, node, ctx):
+        if not isinstance(node, ir.Window):
+            return None
+        c = node.child
+        if not isinstance(c, ir.Join):
+            return None
+        if c.how != "left":
+            ctx.reject(self.name,
+                       f"{c.how} join can drop/repeat probe rows")
+            return None
+        ls = ctx.schema(c.left)
+        rs = ctx.schema(c.right)
+        wcols = set(node.partition_by) | set(node.order_by)
+        if node.value is not None:
+            wcols.add(node.value)
+        if not wcols <= set(ls):
+            ctx.reject(self.name, "window keys straddle the join")
+            return None
+        if not _unique_on(c.right, c.right_on, ctx):
+            ctx.reject(self.name,
+                       "build side not provably unique on join keys")
+            return None
+        ctx.fire(self.name, f"window({node.fn}) below {c.how} join")
+        win = replace(node, child=c.left)
+        return ir.Project(replace(c, left=win), ls + rs + (node.out,))
+
+
+def _unique_on(node: ir.Plan, keys, ctx: Context) -> bool:
+    """True when ``node``'s output is structurally unique on ``keys``."""
+    if isinstance(node, ir.Aggregate) and node.grouping is None:
+        return set(node.keys) == set(keys)
+    if isinstance(node, ir.FusedJoinAggregate):
+        return set(node.keys) == set(keys)
+    if isinstance(node, ir.Distinct):
+        return set(ctx.schema(node)) == set(keys)
+    return False
+
+
 DEFAULT_RULES: tuple[Rule, ...] = (
     ProjectionPushdown(), FilterPushdown(), JoinReorder(),
-    FuseJoinAggregate(),
+    FuseJoinAggregate(), FuseJoinWindow(),
 )
 
 

@@ -341,6 +341,17 @@ register("SRJT_AQE_REPLAN_MIN_ROWS", "64", _int,
          "AQE skips join reorder when every pending input is smaller "
          "than this (replan overhead not worth it)", "plan")
 
+# SQL front-end
+register("SRJT_SQL_CACHE", "1", _on_unless_0_off,
+         "memoize SQL text → optimized plan tree per (text, params, "
+         "schema) so repeat submissions skip parse+bind+optimize; "
+         "`0`/`off` reparses every call (bench baseline)", "sql")
+register("SRJT_SQL_CACHE_CAP", "256", _int,
+         "parsed-plan memo entry cap (LRU)", "sql")
+register("SRJT_SQL_MAX_LEN", "262144", _int,
+         "reject SQL text longer than this many characters before "
+         "tokenizing (serving-surface input bound)", "sql")
+
 # parquet scan
 register("SRJT_DICT_STRINGS", "1", _on_unless_0_off,
          "dictionary-encoded string fast path; `0`/`off` reverts to "
@@ -430,6 +441,9 @@ register("SRJT_QB_EXPLAIN", "0", _is_1,
 register("SRJT_QB_PROFILE", "0", _is_1,
          "query_bench attaches per-plan-node profiles (`--profile`) to "
          "QUERY_BENCH.json entries", "tools")
+register("SRJT_QB_SQL", "0", _is_1,
+         "query_bench compiles the TPC-DS mix from `models/tpcds_sql.py` "
+         "SQL text (`--sql`) instead of prebuilt plan trees", "tools")
 register("SRJT_BENCH_TRIES", "0", _int,
          "bench.py crash-resume attempt counter", "tools")
 register("SRJT_BENCH_BUDGET_S", "1200", _float,
@@ -447,6 +461,7 @@ _SECTION_TITLES = {
     "ops": "Joins (`ops/`)",
     "rowconv": "Row conversion (`rowconv/`)",
     "plan": "Plan optimizer (`plan/`)",
+    "sql": "SQL front-end (`sql/`)",
     "parquet": "Parquet scan (`parquet/`)",
     "ml": "ML handoff (`ml/`)",
     "stream": "Streaming (`stream/`)",

@@ -43,7 +43,15 @@ def _tables_equal(a, b):
                                           np.asarray(cb.to_numpy()))
 
 
-@pytest.mark.parametrize("qname", sorted(tpcds.QUERIES))
+# the three heaviest JIT compiles ride the slow lane; the other ~20
+# cases keep capture/replay bit-identity inside the tier-1 time budget
+_SLOW_COMPILE = {"q27_cube", "q19", "q36_rollup"}
+
+
+@pytest.mark.parametrize(
+    "qname", [pytest.param(q, marks=pytest.mark.slow)
+              if q in _SLOW_COMPILE else q
+              for q in sorted(tpcds.QUERIES)])
 def test_compiled_matches_eager(tables, qname):
     qfn = tpcds.QUERIES[qname]
     cq = compile_query(qfn, tables)

@@ -211,6 +211,7 @@ def test_groupby_differential(raw, monkeypatch):
 # --- join (multi-file, incompatible per-file dictionaries) ------------------
 
 
+@pytest.mark.slow      # heaviest dict-path JIT in the module (~37 s)
 def test_join_across_incompatible_dictionaries(raw, monkeypatch):
     import pyarrow as pa
     # second file: overlapping-but-different dictionary (other card/order)

@@ -566,3 +566,57 @@ def test_fused_fingerprint_falls_back_to_join():
     for how in ("inner", "left"):
         _fused_vs_unfused_how(lt, rt, [0, 1], [0, 1], [2],
                               [(3, "sum"), (3, "count")], how)
+
+
+def test_decimal128_single_key_fingerprint_verify_path():
+    """A lone decimal128 key must route through the hashed
+    fingerprint-and-verify pack (its (n, 2) limb storage has no single
+    probe lane for the sort-probe engine) and produce the same indices
+    as an int64 key with identical equality structure."""
+    from spark_rapids_jni_tpu.ops import decimal128 as d128
+
+    # mirror: same positions match in both keyings; the >64-bit values
+    # force real two-limb equality through the verify lanes
+    lmap = {0: 3, 1: 1, 2: 2, 3: 3, 4: 5, 5: 2**70, 6: -2**70, 7: 7}
+    rmap = {0: 2, 1: 3, 2: 5, 3: 2**70, 4: 9, 5: -2**70}
+    lc = d128.from_pyints([lmap[i] for i in range(8)], scale=0)
+    rc = d128.from_pyints([rmap[i] for i in range(6)], scale=0)
+    small = {2**70: 100, -2**70: -100}
+    li = int_col(np.asarray([small.get(lmap[i], lmap[i])
+                             for i in range(8)], np.int64))
+    ri = int_col(np.asarray([small.get(rmap[i], rmap[i])
+                             for i in range(6)], np.int64))
+
+    plan = join_plan.plan_keys([lc], [rc])
+    assert plan.mode == "fallback"
+    assert plan.ldata.ndim == 1 and len(plan.verify) == 2
+
+    for how in ("inner", "left"):
+        dl, dr = join_indices(lc, rc, how)
+        il, ir_ = join_indices(li, ri, how)
+        assert sorted(zip(np.asarray(dl).tolist(),
+                          np.asarray(dr).tolist())) \
+            == sorted(zip(np.asarray(il).tolist(),
+                          np.asarray(ir_).tolist()))
+    for how in ("semi", "anti"):
+        assert np.asarray(join_indices(lc, rc, how)).tolist() \
+            == np.asarray(join_indices(li, ri, how)).tolist()
+
+
+def test_decimal128_key_with_nulls_and_collision_scale():
+    """Nulls never match, and same-low-limb values differing only in the
+    high limb (fingerprint collision bait) are kept apart by the verify
+    lanes."""
+    from spark_rapids_jni_tpu.ops import decimal128 as d128
+
+    # low limbs equal, high limbs differ: v and v + 2**64
+    lv = [5, 5 + 2**64, None, 9]
+    rv = [5, 9, None, 5 + 2**64]
+    lc = d128.from_pyints(lv, scale=0)
+    rc = d128.from_pyints(rv, scale=0)
+    dl, dr = join_indices(lc, rc, "inner")
+    pairs = sorted(zip(np.asarray(dl).tolist(), np.asarray(dr).tolist()))
+    expect = sorted((i, j) for i, a in enumerate(lv)
+                    for j, b in enumerate(rv)
+                    if a is not None and b is not None and a == b)
+    assert pairs == expect

@@ -72,6 +72,7 @@ def test_etl_matches_pandas(files, dfs):
                                   exp.first_period.to_numpy().astype(np.int32))
 
 
+@pytest.mark.slow      # full second ETL run just to re-check code maps
 def test_categorical_codes_consistent(files, dfs):
     out = mortgage.etl(files)
     exp = _expected_features(dfs)

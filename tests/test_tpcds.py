@@ -347,6 +347,7 @@ def test_q_isin_states(tables, dfs):
     _assert_result(out, exp, ["s_state"], [("ss_ext_sales_price", "float")])
 
 
+@pytest.mark.slow      # whole-corpus sweep; every query has its own test
 def test_run_all_executes_every_query(files):
     outs = tpcds.run_all(files)
     assert len(outs) == len(tpcds.QUERIES) >= 21

@@ -109,6 +109,12 @@ def main():
         # survives the crash-handler os.execv via the env knob
         sys.argv.remove("--profile")
         os.environ["SRJT_QB_PROFILE"] = "1"
+    if "--sql" in sys.argv:
+        # serve the SQL ports of the corpus (models/tpcds_sql.py) through
+        # the front-end instead of the hand-fused queries — same tables,
+        # same measurement; survives re-exec via the env knob
+        sys.argv.remove("--sql")
+        os.environ["SRJT_QB_SQL"] = "1"
     n_sales = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
     out_path = sys.argv[2] if len(sys.argv) > 2 else "QUERY_BENCH.json"
     print(f"backend: {jax.default_backend()}  n_sales: {n_sales}", flush=True)
@@ -118,6 +124,7 @@ def main():
     from spark_rapids_jni_tpu.models.compiled import compile_query
     from spark_rapids_jni_tpu.utils import knobs, metrics, syncs
 
+    use_sql = knobs.get("SRJT_QB_SQL")
     use_metrics = knobs.get("SRJT_QB_METRICS")
     trace_dir = knobs.get("SRJT_QB_TRACE_DIR")
     if trace_dir:
@@ -140,7 +147,12 @@ def main():
     RESULTS["load_s"] = round(load_s, 1)
     print(f"decode+upload: {load_s:.1f}s", flush=True)
 
-    chosen = (sorted(tpcds.QUERIES)
+    if use_sql:
+        from spark_rapids_jni_tpu import sql as sql_fe
+        from spark_rapids_jni_tpu.models import tpcds_sql
+        RESULTS["mode"] = "sql"
+    catalog = tpcds_sql.SQL if use_sql else tpcds.QUERIES
+    chosen = (sorted(catalog)
               if len(sys.argv) <= 3 or sys.argv[3] == "all"
               else sys.argv[3].split(","))
 
@@ -201,7 +213,12 @@ def main():
                     **prev, "gave_up": "attempt budget (hang/crash?)"}
             if done or gave_up:
                 continue
-        fn = tpcds.QUERIES[name]
+        if use_sql:
+            fn = sql_fe.compile_sql(tpcds_sql.SQL[name],
+                                    tpcds_sql.TABLE_SCHEMAS,
+                                    tpcds_sql.PARAMS.get(name, {}))
+        else:
+            fn = tpcds.QUERIES[name]
         # attempt accounting is written to disk BEFORE the query runs: a
         # hung remote compile leaves no exception, so the only evidence a
         # watchdog-killed attempt happened is this counter.  3 strikes →

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""SQL front-end overhead benchmark → SQL_BENCH.json.
+
+Measures what the SQL surface ADDS on top of pre-built plan trees, per
+corpus query (``models/tpcds_sql.py``):
+
+  parse_us     — tokenizer + recursive-descent parse alone
+  bind_us      — parse + name resolution into the raw IR tree
+  cold_us      — parse + bind + rule optimization (memo bypassed):
+                 the full cost of the first-ever submission of a text
+  hand_us      — building + optimizing the equivalent hand tree: the
+                 pre-built-tree baseline the overhead is measured against
+  warm_us      — a repeat ``sql_to_plan`` under ``SRJT_SQL_CACHE``: one
+                 dict probe, which is why a warm SQL submission is
+                 amortized-FREE against pre-built trees (and the plan
+                 cache dedupes the compile via the shared fingerprint)
+
+Pure host-side work — no device, no tables, no decode.  Run anywhere:
+
+    python tools/sql_bench.py [repeats] [out.json]
+"""
+
+import json
+import statistics
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def _best_us(fn, repeats: int) -> float:
+    """Median-of-repeats wall time in microseconds."""
+    samples = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        samples.append((time.perf_counter() - t0) * 1e6)
+    return round(statistics.median(samples), 1)
+
+
+def main():
+    repeats = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    out_path = sys.argv[2] if len(sys.argv) > 2 else "SQL_BENCH.json"
+
+    from spark_rapids_jni_tpu import sql as sql_fe
+    from spark_rapids_jni_tpu.models import tpcds_sql as TS
+    from spark_rapids_jni_tpu.plan import ir, rules
+    from spark_rapids_jni_tpu.sql import binder, parser
+
+    results = {"repeats": repeats, "queries": {}}
+    for name in TS.QUERY_NAMES:
+        text = TS.SQL[name]
+        params = TS.PARAMS.get(name, {})
+        schemas = TS.TABLE_SCHEMAS
+
+        parse_us = _best_us(lambda: parser.parse(text), repeats)
+        bind_us = _best_us(
+            lambda: binder.bind(parser.parse(text), schemas, params, text),
+            repeats)
+        cold_us = _best_us(
+            lambda: sql_fe.sql_to_plan(text, schemas, params,
+                                       stats=None, optimize=True)
+            if sql_fe.clear_cache() is None else None, repeats)
+        hand_us = _best_us(
+            lambda: rules.optimize(TS.hand_tree(name), schemas), repeats)
+        sql_fe.clear_cache()
+        sql_fe.sql_to_plan(text, schemas, params)          # prime the memo
+        warm_us = _best_us(
+            lambda: sql_fe.sql_to_plan(text, schemas, params), repeats)
+
+        # the differential invariant the whole design rests on
+        fp_sql = ir.fingerprint(sql_fe.sql_to_plan(text, schemas, params))
+        fp_hand = ir.fingerprint(rules.optimize(TS.hand_tree(name),
+                                                schemas).tree)
+        results["queries"][name] = {
+            "parse_us": parse_us, "bind_us": bind_us, "cold_us": cold_us,
+            "hand_us": hand_us, "warm_us": warm_us,
+            "overhead_cold_us": round(cold_us - hand_us, 1),
+            "fingerprint_shared": fp_sql == fp_hand,
+        }
+        print(f"{name:>20}: parse {parse_us:7.1f}us  cold {cold_us:7.1f}us"
+              f"  hand {hand_us:7.1f}us  warm {warm_us:6.1f}us  "
+              f"fp_shared={fp_sql == fp_hand}", flush=True)
+
+    q = results["queries"]
+    results["summary"] = {
+        "n_queries": len(q),
+        "all_fingerprints_shared": all(e["fingerprint_shared"]
+                                       for e in q.values()),
+        "median_cold_overhead_us": round(statistics.median(
+            e["overhead_cold_us"] for e in q.values()), 1),
+        "median_warm_us": round(statistics.median(
+            e["warm_us"] for e in q.values()), 1),
+    }
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"\n{results['summary']}")
+    print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()
